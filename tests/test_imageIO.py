@@ -1,0 +1,151 @@
+"""Image codec tests — round-trips, golden PIL-oracle resize, custom reader.
+
+Mirrors the reference's python/tests/image/test_imageIO.py techniques
+(SURVEY.md §4): struct round-trips, resize vs PIL oracle, fixture images on
+disk read through readImagesWithCustomFn.
+"""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tpudl.image import imageIO as io_
+
+
+@pytest.fixture(scope="module")
+def fixture_dir(tmp_path_factory, ):
+    """Generate small deterministic JPEG/PNG fixtures (no network)."""
+    rng = np.random.default_rng(7)
+    d = tmp_path_factory.mktemp("images")
+    for i, size in enumerate([(32, 48), (64, 40), (21, 33)]):
+        arr = rng.integers(0, 255, size=(*size, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / f"img{i}.png")
+    Image.fromarray(
+        rng.integers(0, 255, size=(30, 30), dtype=np.uint8), mode="L"
+    ).save(d / "gray.png")
+    (d / "not_an_image.txt").write_bytes(b"definitely not a jpeg")
+    return d
+
+
+def test_mode_tables():
+    assert io_.imageTypeByName("CV_8UC3").ord == 16
+    assert io_.imageTypeByOrdinal(16).dtype == "uint8"
+    assert io_.imageTypeByOrdinal(21).dtype == "float32"
+    assert io_.imageTypeByOrdinal(24).nChannels == 4
+    with pytest.raises(KeyError):
+        io_.imageTypeByOrdinal(99)
+    with pytest.raises(KeyError):
+        io_.imageTypeByName("CV_64FC3")
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    ((8, 6, 3), np.uint8),
+    ((8, 6, 1), np.uint8),
+    ((8, 6, 4), np.uint8),
+    ((5, 7, 3), np.float32),
+    ((5, 7), np.uint8),
+])
+def test_struct_roundtrip(shape, dtype, rng):
+    if dtype == np.uint8:
+        arr = rng.integers(0, 255, size=shape).astype(np.uint8)
+    else:
+        arr = rng.normal(size=shape).astype(np.float32)
+    struct = io_.imageArrayToStruct(arr, origin="mem://x")
+    back = io_.imageStructToArray(struct)
+    expect = arr[:, :, None] if arr.ndim == 2 else arr
+    np.testing.assert_array_equal(back, expect)
+    assert struct["origin"] == "mem://x"
+    assert struct["height"] == shape[0] and struct["width"] == shape[1]
+
+
+def test_decode_stores_bgr(rng):
+    """PIL gives RGB; the struct must store BGR (Spark/OpenCV convention)."""
+    rgb = rng.integers(0, 255, size=(10, 12, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(rgb).save(buf, format="PNG")
+    struct = io_.PIL_decode(buf.getvalue(), origin="a.png")
+    arr = io_.imageStructToArray(struct)
+    np.testing.assert_array_equal(arr, rgb[:, :, ::-1])
+
+
+def test_decode_garbage_returns_none():
+    assert io_.PIL_decode(b"not an image") is None
+
+
+def test_resize_matches_pil_oracle(rng):
+    rgb = rng.integers(0, 255, size=(40, 30, 3), dtype=np.uint8)
+    struct = io_.imageArrayToStruct(rgb[:, :, ::-1])
+    resized = io_.resizeImage(struct, 20, 15)
+    got = io_.imageStructToArray(resized)
+    expect = np.asarray(
+        Image.fromarray(rgb).resize((15, 20), Image.BILINEAR), dtype=np.uint8
+    )[:, :, ::-1]
+    np.testing.assert_array_equal(got, expect)
+    assert (resized["height"], resized["width"]) == (20, 15)
+
+
+def test_resize_noop_same_size(rng):
+    rgb = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+    struct = io_.imageArrayToStruct(rgb)
+    assert io_.resizeImage(struct, 8, 8) is struct
+
+
+def test_read_images_custom_fn(fixture_dir):
+    frame = io_.readImagesWithCustomFn(str(fixture_dir), io_.PIL_decode)
+    assert frame.columns == ["image"]
+    rows = list(frame["image"])
+    # 4 decodable images + 1 garbage file → None
+    assert len(rows) == 5
+    assert sum(r is None for r in rows) == 1
+    ok = [r for r in rows if r is not None]
+    assert all(r["nChannels"] == 3 for r in ok)  # gray widened to 3ch
+    assert all(r["origin"] for r in ok)
+
+
+def test_files_to_frame(fixture_dir):
+    frame = io_.filesToFrame(str(fixture_dir))
+    assert frame.columns == ["filePath", "fileData"]
+    assert len(frame) == 5
+    assert isinstance(frame["fileData"][0], bytes)
+
+
+def test_pil_decode_and_resize(rng):
+    rgb = rng.integers(0, 255, size=(50, 60, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(rgb).save(buf, format="PNG")
+    struct = io_.PIL_decode_and_resize(buf.getvalue(), (25, 30))
+    assert (struct["height"], struct["width"]) == (25, 30)
+
+
+def test_resize_float_struct_keeps_dtype(rng):
+    """CV_32FC3 structs must survive resize as float32 (regression: they were
+    clipped to uint8 zeros)."""
+    arr = rng.random(size=(16, 12, 3)).astype(np.float32)
+    struct = io_.imageArrayToStruct(arr)
+    assert struct["mode"] == 21
+    resized = io_.resizeImage(struct, 8, 6)
+    assert resized["mode"] == 21
+    out = io_.imageStructToArray(resized)
+    assert out.dtype == np.float32
+    # channel-wise PIL 'F' oracle
+    expect = np.stack(
+        [
+            np.asarray(
+                Image.fromarray(arr[:, :, c], mode="F").resize((6, 8), Image.BILINEAR),
+                dtype=np.float32,
+            )
+            for c in range(3)
+        ],
+        axis=-1,
+    )
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_struct_to_array_writable_by_default(rng):
+    struct = io_.imageArrayToStruct(rng.integers(0, 255, (4, 4, 3)).astype(np.uint8))
+    arr = io_.imageStructToArray(struct)
+    arr[0, 0, 0] = 5  # must not raise
+    view = io_.imageStructToArray(struct, copy=False)
+    assert not view.flags.writeable

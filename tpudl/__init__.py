@@ -1,0 +1,35 @@
+"""tpudl — TPU-native deep learning pipelines.
+
+A ground-up jax/XLA/Flax framework with the capability surface of
+`spark-deep-learning` (`sparkdl`): see SURVEY.md for the blueprint and the
+per-module docstrings for reference anchors. The public API mirrors the
+reference's names (ref: python/sparkdl/__init__.py:~L1-40) so a sparkdl
+user finds everything under the same spelling, while execution is fused
+jitted programs on a TPU mesh.
+"""
+
+import importlib
+
+from tpudl.version import __version__
+
+# symbol → defining module. Extended as layers land; __all__ derives from it
+# so star-import never advertises a module that does not exist yet.
+_LAZY = {
+    "Frame": "tpudl.frame",
+    "sql": "tpudl.frame",
+    "register_udf": "tpudl.udf",
+}
+
+__all__ = ["__version__", *_LAZY]
+
+
+def __getattr__(name):
+    # Lazy re-exports: keep `import tpudl` light (no TF, no model zoo) until
+    # a symbol is actually used.
+    if name in _LAZY:
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module 'tpudl' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

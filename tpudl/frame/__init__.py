@@ -1,0 +1,2 @@
+from tpudl.frame.frame import Frame, concat  # noqa: F401
+from tpudl.frame.sql import sql  # noqa: F401

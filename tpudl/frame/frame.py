@@ -1,0 +1,225 @@
+"""Minimal columnar batch abstraction — the Spark DataFrame stand-in.
+
+SURVEY.md §7.1 item 3: "intentionally small — transport, not a query
+engine". A Frame is an ordered dict of equal-length named columns. Numeric
+columns are numpy arrays; ragged/struct/string columns are object arrays.
+``map_batches`` is the executor: it packs host batches, pads and shards
+them over the mesh's data axis, runs ONE jitted function per batch (the
+reference's one-native-call-per-block invariant, SURVEY.md §3.2), and
+appends the outputs as new columns.
+
+The reference equivalent is the Spark DataFrame + TensorFrames MapBlocks
+path (ref: sparkdl graph/tensorframes_udf.py, tf_image.py:_transform).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Iterator, Mapping, Sequence
+
+import numpy as np
+
+__all__ = ["Frame", "concat"]
+
+
+def _as_column(values) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    values = list(values)
+    if values and isinstance(values[0], (dict, bytes, str, type(None))):
+        col = np.empty(len(values), dtype=object)
+        col[:] = values
+        return col
+    try:
+        return np.asarray(values)
+    except Exception:
+        col = np.empty(len(values), dtype=object)
+        col[:] = values
+        return col
+
+
+class Frame:
+    """Ordered named columns of equal length."""
+
+    def __init__(self, columns: Mapping[str, object], num_partitions: int | None = None):
+        self._cols: dict[str, np.ndarray] = {}
+        n = None
+        for name, values in columns.items():
+            col = _as_column(values)
+            if n is None:
+                n = len(col)
+            elif len(col) != n:
+                raise ValueError(
+                    f"column {name!r} has length {len(col)}, expected {n}"
+                )
+            self._cols[str(name)] = col
+        self._n = n or 0
+        self.num_partitions = num_partitions
+
+    # -- schema/access ----------------------------------------------------
+    @property
+    def columns(self) -> list[str]:
+        return list(self._cols)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._cols[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{k}:{v.dtype}" for k, v in self._cols.items())
+        return f"Frame[{self._n} rows]({cols})"
+
+    # -- relational-lite --------------------------------------------------
+    def select(self, *names: str) -> "Frame":
+        missing = [n for n in names if n not in self._cols]
+        if missing:
+            raise KeyError(f"unknown columns {missing}; have {self.columns}")
+        return Frame({n: self._cols[n] for n in names}, self.num_partitions)
+
+    def with_column(self, name: str, values) -> "Frame":
+        col = _as_column(values)
+        if len(col) != self._n:
+            raise ValueError(f"column length {len(col)} != frame length {self._n}")
+        out = dict(self._cols)
+        out[name] = col
+        return Frame(out, self.num_partitions)
+
+    def with_column_renamed(self, old: str, new: str) -> "Frame":
+        return Frame(
+            {new if k == old else k: v for k, v in self._cols.items()},
+            self.num_partitions,
+        )
+
+    def drop(self, *names: str) -> "Frame":
+        return Frame(
+            {k: v for k, v in self._cols.items() if k not in names},
+            self.num_partitions,
+        )
+
+    def filter_rows(self, mask) -> "Frame":
+        mask = np.asarray(mask, dtype=bool)
+        return Frame({k: v[mask] for k, v in self._cols.items()}, self.num_partitions)
+
+    def dropna(self, subset: Sequence[str] | None = None) -> "Frame":
+        names = list(subset) if subset else self.columns
+        mask = np.ones(self._n, dtype=bool)
+        for n in names:
+            col = self._cols[n]
+            if col.dtype == object:
+                mask &= np.array([v is not None for v in col], dtype=bool)
+            elif np.issubdtype(col.dtype, np.floating):
+                mask &= ~np.isnan(col)
+        return self.filter_rows(mask)
+
+    def head(self, n: int = 5) -> "Frame":
+        return Frame({k: v[:n] for k, v in self._cols.items()}, self.num_partitions)
+
+    def limit(self, n: int) -> "Frame":
+        return self.head(n)
+
+    def to_dict(self) -> dict[str, np.ndarray]:
+        return dict(self._cols)
+
+    def rows(self) -> Iterator[dict]:
+        for i in range(self._n):
+            yield {k: v[i] for k, v in self._cols.items()}
+
+    def collect(self) -> list[dict]:
+        return list(self.rows())
+
+    # -- executor ---------------------------------------------------------
+    def iter_batches(self, batch_size: int) -> Iterator[tuple[int, int]]:
+        for start in range(0, self._n, batch_size):
+            yield start, min(start + batch_size, self._n)
+
+    def map_batches(
+        self,
+        fn: Callable,
+        input_cols: Sequence[str],
+        output_cols: Sequence[str],
+        *,
+        batch_size: int = 256,
+        mesh=None,
+        pack: Callable | None = None,
+    ) -> "Frame":
+        """Run ``fn`` over the frame in device-sized batches; append outputs.
+
+        ``fn`` maps packed input arrays → one array or a tuple matching
+        ``output_cols``. ``pack`` converts a column slice (object arrays
+        included) to a stacked numpy batch; defaults to ``np.stack``-like
+        coercion. When ``mesh`` is given, batches are padded to the data-axis
+        size and sharded before the call (the infeed edge); outputs are
+        fetched and unpadded. This is the rebuild of the reference's
+        per-partition TensorFrames MapBlocks execution, minus the JVM.
+        """
+        from tpudl import mesh as M
+
+        missing = [c for c in input_cols if c not in self._cols]
+        if missing:
+            raise KeyError(f"unknown input columns {missing}")
+        outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
+        multiple = mesh.shape[M.DATA_AXIS] if mesh is not None else 1
+        for start, stop in self.iter_batches(batch_size):
+            packed = []
+            for c in input_cols:
+                sl = self._cols[c][start:stop]
+                arr = pack(sl) if pack is not None else _default_pack(sl)
+                packed.append(arr)
+            n_pads = []
+            if mesh is not None:
+                padded = []
+                for arr in packed:
+                    p, n_pad = M.pad_batch(arr, multiple)
+                    padded.append(p)
+                    n_pads.append(n_pad)
+                packed = [M.shard_batch(p, mesh) for p in padded]
+            result = fn(*packed)
+            if not isinstance(result, (tuple, list)):
+                result = (result,)
+            if len(result) != len(output_cols):
+                raise ValueError(
+                    f"fn returned {len(result)} outputs, expected {len(output_cols)}"
+                )
+            for i, r in enumerate(result):
+                r = np.asarray(r)
+                if n_pads and n_pads[0]:
+                    r = M.unpad_batch(r, n_pads[0])
+                outputs[i].append(r)
+        out = self
+        for name, chunks in zip(output_cols, outputs):
+            col = np.concatenate(chunks, axis=0) if chunks else np.empty((0,))
+            if col.ndim > 1:
+                obj = np.empty(len(col), dtype=object)
+                obj[:] = list(col)
+                col = obj
+            out = out.with_column(name, col)
+        return out
+
+
+def _default_pack(sl: np.ndarray) -> np.ndarray:
+    if sl.dtype == object:
+        return np.stack([np.asarray(v) for v in sl])
+    return np.asarray(sl)
+
+
+def concat(frames: Sequence[Frame]) -> Frame:
+    if not frames:
+        raise ValueError("concat of zero frames")
+    names = frames[0].columns
+    out = {}
+    for n in names:
+        cols = [f[n] for f in frames]
+        if any(c.dtype == object for c in cols):
+            merged = np.empty(sum(len(c) for c in cols), dtype=object)
+            i = 0
+            for c in cols:
+                merged[i : i + len(c)] = c
+                i += len(c)
+            out[n] = merged
+        else:
+            out[n] = np.concatenate(cols, axis=0)
+    return Frame(out, frames[0].num_partitions)

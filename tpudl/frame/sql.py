@@ -1,0 +1,73 @@
+"""Deliberately tiny SQL SELECT layer for model-as-UDF parity.
+
+The reference registers Keras models as Spark SQL UDFs and users write
+``SELECT my_udf(image) FROM images`` (ref: sparkdl udf/keras_image_model.py
+~L30, graph/tensorframes_udf.py ~L20; SURVEY.md §3.4). We are explicitly
+NOT a query engine (SURVEY.md §7.1 item 3), so this module implements only
+the projection shape that contract needs:
+
+    SELECT <item> [, <item>...] FROM <table> [LIMIT n]
+    item := col | fn(col) | fn(col) AS alias
+
+Registered UDFs come from :mod:`tpudl.udf.registry`; execution of a model
+UDF is a batched jitted call, not per-row Python.
+"""
+
+from __future__ import annotations
+
+import re
+
+from tpudl.frame.frame import Frame
+
+__all__ = ["sql"]
+
+_SELECT_RE = re.compile(
+    r"^\s*select\s+(?P<items>.+?)\s+from\s+(?P<table>\w+)"
+    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+_ITEM_RE = re.compile(
+    r"^\s*(?:(?P<fn>\w+)\s*\(\s*(?P<arg>\w+)\s*\)|(?P<col>\w+))"
+    r"(?:\s+as\s+(?P<alias>\w+))?\s*$",
+    re.IGNORECASE,
+)
+
+
+def sql(query: str, tables: dict[str, Frame]) -> Frame:
+    m = _SELECT_RE.match(query)
+    if not m:
+        raise ValueError(
+            f"unsupported SQL (only 'SELECT items FROM table [LIMIT n]'): {query!r}"
+        )
+    table = m.group("table")
+    if table not in tables:
+        raise KeyError(f"unknown table {table!r}; registered: {sorted(tables)}")
+    frame = tables[table]
+    limit = m.group("limit")
+    if limit is not None:
+        frame = frame.limit(int(limit))
+
+    out: dict[str, object] = {}
+    for raw in _split_items(m.group("items")):
+        if raw == "*":
+            raise ValueError("SELECT * not supported; name columns explicitly")
+        im = _ITEM_RE.match(raw)
+        if not im:
+            raise ValueError(f"unsupported select item: {raw!r}")
+        if im.group("col"):
+            name = im.group("alias") or im.group("col")
+            out[name] = frame[im.group("col")]
+        else:
+            from tpudl.udf import registry
+
+            fn_name, arg = im.group("fn"), im.group("arg")
+            name = im.group("alias") or f"{fn_name}({arg})"
+            udf = registry.get_udf(fn_name)
+            result = udf(frame.select(arg).with_column_renamed(arg, udf.input_col))
+            out[name] = result[udf.output_col]
+    return Frame(out)
+
+
+def _split_items(items: str) -> list[str]:
+    # split on top-level commas (no nested parens in our grammar)
+    return [p for p in (s.strip() for s in items.split(",")) if p]

@@ -1,0 +1,11 @@
+from tpudl.image import imageIO, ops  # noqa: F401
+from tpudl.image.imageIO import (  # noqa: F401
+    ImageType,
+    imageArrayToStruct,
+    imageStructToArray,
+    imageTypeByName,
+    imageTypeByOrdinal,
+    readImages,
+    readImagesWithCustomFn,
+    resizeImage,
+)

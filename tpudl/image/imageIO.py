@@ -1,0 +1,307 @@
+"""Image struct ⇄ ndarray codec, decode and resize.
+
+TPU-native rebuild of the reference's image I/O layer
+(ref: python/sparkdl/image/imageIO.py — imageArrayToStruct ~L120,
+imageStructToArray ~L100, imageTypeByOrdinal/Name ~L40-80,
+createResizeImageUDF/resizeImage ~L180, readImagesWithCustomFn ~L220-280,
+filesToDF ~L200; JVM twin src/main/scala/com/databricks/sparkdl/ImageUtils.scala).
+
+Parity-sensitive layer (SURVEY.md §7.1 item 2): the struct layout is the
+Spark image schema — ``origin, height, width, nChannels, mode, data`` with
+OpenCV type ordinals and **BGR** channel order for 3/4-channel images, data
+row-major. Host-side decode uses PIL (same as the reference's Python path);
+device-side conversion to model-ready float tensors lives in
+:mod:`tpudl.image.ops` so it fuses into the jitted model program instead of
+being a per-row UDF.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from io import BytesIO
+from typing import Callable, Iterable
+
+import numpy as np
+
+try:  # PIL is the decode substrate, mirroring the reference's Python path
+    from PIL import Image
+except ImportError:  # pragma: no cover
+    Image = None
+
+__all__ = [
+    "ImageType",
+    "supportedImageTypes",
+    "imageTypeByOrdinal",
+    "imageTypeByName",
+    "imageArrayToStruct",
+    "imageStructToArray",
+    "imageStructToPIL",
+    "PIL_decode",
+    "PIL_decode_and_resize",
+    "resizeImage",
+    "filesToFrame",
+    "readImagesWithCustomFn",
+    "SPARK_MODE",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageType:
+    """One OpenCV storage mode of the Spark image schema.
+
+    ref: imageIO.py's _OcvType table (~L40-80): CV_8UC{1,3,4} and
+    CV_32FC{1,3,4} are the modes sparkdl round-trips.
+    """
+
+    name: str
+    ord: int
+    nChannels: int
+    dtype: str
+
+
+_SUPPORTED = [
+    ImageType("CV_8UC1", 0, 1, "uint8"),
+    ImageType("CV_32FC1", 5, 1, "float32"),
+    ImageType("CV_8UC3", 16, 3, "uint8"),
+    ImageType("CV_32FC3", 21, 3, "float32"),
+    ImageType("CV_8UC4", 24, 4, "uint8"),
+    ImageType("CV_32FC4", 29, 4, "float32"),
+]
+_BY_ORD = {t.ord: t for t in _SUPPORTED}
+_BY_NAME = {t.name: t for t in _SUPPORTED}
+
+
+class SPARK_MODE:
+    """Symbolic channel orders (ref: tf_image.py channelOrder param, v1.x)."""
+
+    BGR = "BGR"
+    RGB = "RGB"
+    GRAY = "L"
+
+
+def supportedImageTypes() -> list[ImageType]:
+    return list(_SUPPORTED)
+
+
+def imageTypeByOrdinal(ord: int) -> ImageType:
+    if ord not in _BY_ORD:
+        raise KeyError(
+            f"unsupported image mode ordinal {ord}; supported: {sorted(_BY_ORD)}"
+        )
+    return _BY_ORD[ord]
+
+
+def imageTypeByName(name: str) -> ImageType:
+    if name not in _BY_NAME:
+        raise KeyError(
+            f"unsupported image mode {name!r}; supported: {sorted(_BY_NAME)}"
+        )
+    return _BY_NAME[name]
+
+
+def imageArrayToStruct(imgArray: np.ndarray, origin: str = "") -> dict:
+    """ndarray (H, W, C) or (H, W) → Spark image struct dict.
+
+    The array is assumed to already be in storage channel order (BGR for
+    color, matching Spark/OpenCV); no flip happens here — flips are explicit
+    at decode (`PIL_decode`) or on-device (`tpudl.image.ops`).
+    """
+    arr = np.asarray(imgArray)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.ndim != 3:
+        raise ValueError(f"image array must be 2-D or 3-D, got shape {arr.shape}")
+    h, w, c = arr.shape
+    if arr.dtype == np.uint8:
+        dtype = "uint8"
+    elif arr.dtype in (np.float32, np.float64):
+        dtype = "float32"
+        arr = arr.astype(np.float32)
+    else:
+        raise ValueError(f"unsupported image array dtype {arr.dtype}")
+    matches = [t for t in _SUPPORTED if t.nChannels == c and t.dtype == dtype]
+    if not matches:
+        raise ValueError(f"no OpenCV mode for nChannels={c} dtype={dtype}")
+    t = matches[0]
+    return {
+        "origin": origin,
+        "height": int(h),
+        "width": int(w),
+        "nChannels": int(c),
+        "mode": t.ord,
+        "data": np.ascontiguousarray(arr).tobytes(),
+    }
+
+
+def imageStructToArray(imageRow: dict, copy: bool = True) -> np.ndarray:
+    """Spark image struct dict → ndarray (H, W, C) in storage order.
+
+    ``copy=False`` returns a read-only view over the struct's bytes for
+    hot-path packing (the subsequent ``np.stack`` copies anyway).
+    """
+    t = imageTypeByOrdinal(imageRow["mode"])
+    shape = (imageRow["height"], imageRow["width"], imageRow["nChannels"])
+    arr = np.frombuffer(imageRow["data"], dtype=t.dtype).reshape(shape)
+    return arr.copy() if copy else arr
+
+
+def imageStructToPIL(imageRow: dict):
+    """struct → PIL image (RGB/L), for resize oracles and visual debugging."""
+    arr = imageStructToArray(imageRow)
+    t = imageTypeByOrdinal(imageRow["mode"])
+    if t.dtype == "float32":
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    c = arr.shape[2]
+    if c == 1:
+        return Image.fromarray(arr[:, :, 0], mode="L")
+    if c == 3:
+        return Image.fromarray(arr[:, :, ::-1], mode="RGB")  # BGR → RGB
+    if c == 4:
+        rgba = arr[:, :, [2, 1, 0, 3]]  # BGRA → RGBA
+        return Image.fromarray(rgba, mode="RGBA")
+    raise ValueError(f"unsupported channel count {c}")
+
+
+def PIL_decode(raw_bytes: bytes, origin: str = "") -> dict | None:
+    """Decode encoded image bytes (JPEG/PNG/...) → image struct, or None.
+
+    ref: imageIO._decodeImage (~L240): undecodable inputs yield null rows
+    rather than failing the job; grayscale widens to 3-channel BGR the way
+    the reference normalizes everything to CV_8UC3.
+    """
+    if Image is None:  # pragma: no cover
+        raise ImportError("PIL is required for image decoding")
+    try:
+        img = Image.open(BytesIO(raw_bytes))
+        img = img.convert("RGB")
+    except Exception:
+        return None
+    rgb = np.asarray(img, dtype=np.uint8)
+    return imageArrayToStruct(rgb[:, :, ::-1], origin=origin)  # store BGR
+
+
+def PIL_decode_and_resize(
+    raw_bytes: bytes, size: tuple[int, int], origin: str = ""
+) -> dict | None:
+    """Decode + resize in one host step (the hot input-pipeline path)."""
+    if Image is None:  # pragma: no cover
+        raise ImportError("PIL is required for image decoding")
+    try:
+        img = Image.open(BytesIO(raw_bytes)).convert("RGB")
+        img = img.resize((size[1], size[0]), Image.BILINEAR)  # PIL takes (W, H)
+    except Exception:
+        return None
+    rgb = np.asarray(img, dtype=np.uint8)
+    return imageArrayToStruct(rgb[:, :, ::-1], origin=origin)
+
+
+def resizeImage(imageRow: dict, height: int, width: int) -> dict:
+    """Bilinear host resize of an image struct, PIL-backed.
+
+    ref: imageIO.createResizeImageUDF (~L180) and ImageUtils.resizeImage —
+    both references resize with bilinear-style filtering before the model.
+    """
+    if (imageRow["height"], imageRow["width"]) == (height, width):
+        return imageRow
+    t = imageTypeByOrdinal(imageRow["mode"])
+    if t.dtype == "float32":
+        # PIL has no multi-channel float mode; resize each channel as 'F'
+        # so CV_32FC* structs keep dtype and values instead of clipping.
+        src = imageStructToArray(imageRow, copy=False)
+        chans = [
+            np.asarray(
+                Image.fromarray(src[:, :, c], mode="F").resize(
+                    (width, height), Image.BILINEAR
+                ),
+                dtype=np.float32,
+            )
+            for c in range(src.shape[2])
+        ]
+        arr = np.stack(chans, axis=-1)
+        return imageArrayToStruct(arr, origin=imageRow.get("origin", ""))
+    pil = imageStructToPIL(imageRow)
+    resized = pil.resize((width, height), Image.BILINEAR)
+    arr = np.asarray(resized, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    elif arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]  # back to BGR storage
+    elif arr.shape[2] == 4:
+        arr = arr[:, :, [2, 1, 0, 3]]
+    return imageArrayToStruct(arr, origin=imageRow.get("origin", ""))
+
+
+def createResizeImageUDF(size: tuple[int, int]) -> Callable[[dict], dict]:
+    """Row-function form, name-parity with the reference (~L180)."""
+    height, width = int(size[0]), int(size[1])
+
+    def _resize(row: dict) -> dict:
+        return resizeImage(row, height, width)
+
+    return _resize
+
+
+def _listFiles(path: str | Iterable[str]) -> list[str]:
+    if isinstance(path, (list, tuple)):
+        return [str(p) for p in path]
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            out.extend(os.path.join(root, f) for f in sorted(files))
+        return sorted(out)
+    return [path]
+
+
+def filesToFrame(path, numPartitions: int | None = None):
+    """Read raw file bytes into a Frame with columns (filePath, fileData).
+
+    ref: imageIO.filesToDF (~L200) — sc.binaryFiles → DataFrame[filePath,
+    fileData]. numPartitions is kept for API parity and forwarded as the
+    Frame's partition hint (used by map_batches scheduling).
+    """
+    from tpudl.frame import Frame
+
+    paths = _listFiles(path)
+    datas = []
+    for p in paths:
+        with open(p, "rb") as f:
+            datas.append(f.read())
+    return Frame(
+        {"filePath": np.array(paths, dtype=object), "fileData": np.array(datas, dtype=object)},
+        num_partitions=numPartitions,
+    )
+
+
+def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None):
+    """Read a directory of images with a custom decode function → Frame["image"].
+
+    ref: imageIO.readImagesWithCustomFn (~L220): binaryFiles → decode_f per
+    file → image-struct column; undecodable files become None rows.
+    ``decode_f`` takes raw bytes and returns an ndarray (H, W, C) **in BGR
+    storage order** or an image struct dict or None.
+    """
+    frame = filesToFrame(path, numPartitions=numPartition)
+    structs = []
+    for origin, raw in zip(frame["filePath"], frame["fileData"]):
+        try:
+            out = decode_f(raw)
+        except Exception:
+            out = None
+        if out is None:
+            structs.append(None)
+        elif isinstance(out, dict):
+            out = dict(out)
+            if not out.get("origin"):
+                out["origin"] = origin
+            structs.append(out)
+        else:
+            structs.append(imageArrayToStruct(np.asarray(out), origin=origin))
+    from tpudl.frame import Frame
+
+    return Frame({"image": np.array(structs, dtype=object)}, num_partitions=numPartition)
+
+
+def readImages(path, numPartition: int | None = None):
+    """Default-decode variant (PIL), matching pre-2.3 sparkdl readImages."""
+    return readImagesWithCustomFn(path, PIL_decode, numPartition=numPartition)

@@ -1,0 +1,77 @@
+"""Device-side image fragments that fuse into jitted model programs.
+
+TPU-native rebuild of the reference's in-graph pieces
+(ref: python/sparkdl/graph/pieces.py — buildSpImageConverter ~L30,
+buildFlattener ~L90). The reference splices protobuf subgraphs so the
+executor makes ONE native call per block (SURVEY.md §3.2 key insight); here
+the same fusion falls out of composing these functions inside one
+``jax.jit`` — XLA fuses the cast/flip/resize/normalize into the conv
+prologue, so the batch crosses host→device exactly once as packed uint8.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "sp_image_converter",
+    "flattener",
+    "resize_bilinear",
+    "to_model_input",
+]
+
+
+def sp_image_converter(batch: jax.Array, channel_order_in: str = "BGR",
+                       channel_order_out: str = "RGB") -> jax.Array:
+    """Packed image batch (B, H, W, C) → float32, in the model's channel order.
+
+    ref: buildSpImageConverter — decode_raw/reshape/cast/BGR-flip as a graph
+    fragment. Decode+reshape happen host-side at pack time (tpudl.frame);
+    the cast and channel flip live here so they fuse on device.
+    """
+    x = batch.astype(jnp.float32)
+    if channel_order_in != channel_order_out:
+        if {channel_order_in, channel_order_out} == {"BGR", "RGB"}:
+            x = x[..., ::-1]
+        elif channel_order_out == "L" or channel_order_in == "L":
+            raise ValueError("grayscale conversion must happen at decode time")
+        else:
+            raise ValueError(
+                f"unsupported channel order {channel_order_in}->{channel_order_out}"
+            )
+    return x
+
+
+def flattener(batch: jax.Array) -> jax.Array:
+    """(B, ...) → (B, prod) float32 — ref: buildFlattener (~L90), the
+    'vector' outputMode of TFImageTransformer."""
+    return batch.reshape(batch.shape[0], -1).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def resize_bilinear(batch: jax.Array, height: int, width: int) -> jax.Array:
+    """Device-side bilinear resize (B, H, W, C) → (B, height, width, C).
+
+    The JVM reference resizes per-row on CPU (ImageUtils.scala, the historic
+    bottleneck per SURVEY.md §3.1); doing it on-device keeps the host loop
+    out of the hot path entirely.
+    """
+    b, _, _, c = batch.shape
+    return jax.image.resize(
+        batch.astype(jnp.float32), (b, height, width, c), method="bilinear"
+    )
+
+
+def to_model_input(batch: jax.Array, height: int, width: int,
+                   channel_order_in: str = "BGR",
+                   channel_order_out: str = "RGB") -> jax.Array:
+    """Fused convert+resize: the standard prologue for every image model."""
+    x = sp_image_converter(batch, channel_order_in, channel_order_out)
+    if batch.shape[1] != height or batch.shape[2] != width:
+        x = jax.image.resize(
+            x, (batch.shape[0], height, width, batch.shape[3]), method="bilinear"
+        )
+    return x

@@ -1,0 +1,130 @@
+"""Device-mesh and sharding core for tpudl.
+
+This is the TPU-native replacement for the reference's entire distribution
+substrate (Spark driver/executor dispatch, torrent broadcast, and the
+HorovodRunner NCCL ring — see SURVEY.md §2.3/§5.8). One logical ``Mesh``
+abstraction carries every parallelism the framework offers:
+
+- ``data``  axis — data-parallel inference/training (the reference's Spark
+  partition map and Horovod allreduce; ref: sparkdl ``tf_image.py:_transform``
+  and HorovodRunner contract).
+- ``model`` axis — reserved for tensor parallelism (absent in the reference,
+  kept open per SURVEY.md §2.4 so it bolts on without redesign).
+
+All helpers are mesh-size-agnostic: they run unchanged on 1 real TPU chip,
+a v5e-8 slice, or an 8-device simulated CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "build_mesh",
+    "replicated",
+    "batch_sharding",
+    "shard_batch",
+    "replicate",
+    "pad_batch",
+    "unpad_batch",
+    "local_device_count",
+    "use_mesh",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def build_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axis_names: tuple[str, ...] = (DATA_AXIS, MODEL_AXIS),
+) -> Mesh:
+    """Build a 2-D logical mesh ``(data, model)`` over the available devices.
+
+    ``n_data`` defaults to ``len(devices) // n_model``. A ``model`` axis of
+    size 1 costs nothing and keeps tensor-parallel shardings expressible
+    without re-tracing user code when the axis later grows.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devs) // n_model
+    want = n_data * n_model
+    if want > len(devs):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {want} devices, have {len(devs)}"
+        )
+    grid = np.asarray(devs[:want]).reshape(n_data, n_model)
+    return Mesh(grid, axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding — the moral equivalent of Spark broadcast."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``; replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicate(tree, mesh: Mesh):
+    """Place every leaf on-device fully replicated (Spark broadcast analogue)."""
+    sh = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def pad_batch(arr: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
+    """Pad the leading dim up to a multiple; returns (padded, n_pad).
+
+    SPMD over a mesh needs batch % n_data == 0; the reference never faced
+    this (Spark partitions are ragged) so this is new, deliberate surface.
+    Padding repeats row 0 to keep dtype/scale realistic for compiled kernels.
+    """
+    n = arr.shape[0]
+    target = math.ceil(n / multiple) * multiple if n else multiple
+    n_pad = target - n
+    if n_pad == 0:
+        return arr, 0
+    pad = np.repeat(arr[:1] if n else np.zeros_like(arr, shape=(1, *arr.shape[1:])), n_pad, axis=0)
+    return np.concatenate([arr, pad], axis=0), n_pad
+
+
+def unpad_batch(arr, n_pad: int):
+    return arr if n_pad == 0 else arr[: arr.shape[0] - n_pad]
+
+
+def shard_batch(tree, mesh: Mesh, axis: str = DATA_AXIS):
+    """device_put every leaf with its leading dim sharded over ``axis``.
+
+    This is the infeed edge: host numpy batches → device-sharded arrays.
+    Leaves must already be padded to a multiple of the axis size.
+    """
+
+    def _put(x):
+        x = np.asarray(x)
+        return jax.device_put(x, batch_sharding(mesh, axis, x.ndim))
+
+    return jax.tree.map(_put, tree)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Context manager installing ``mesh`` for sharding-annotated jit code."""
+    with jax.sharding.use_mesh(mesh):
+        yield mesh

@@ -1,0 +1,2 @@
+from tpudl.udf import registry  # noqa: F401
+from tpudl.udf.registry import get_udf, list_udfs, register_udf  # noqa: F401

@@ -1,0 +1,48 @@
+"""Model-as-UDF registry.
+
+The reference registers frozen graphs as Spark SQL UDFs through
+TensorFrames' JVM layer (ref: sparkdl graph/tensorframes_udf.py:makeGraphUDF
+~L20). Here a UDF is a named callable ``Frame → Frame`` (batched, jitted
+inside) plus the input/output column names the SQL layer binds to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+__all__ = ["UDF", "register_udf", "get_udf", "list_udfs", "unregister_udf"]
+
+
+@dataclasses.dataclass(frozen=True)
+class UDF:
+    name: str
+    fn: Callable  # Frame -> Frame, reading input_col, appending output_col
+    input_col: str
+    output_col: str
+
+    def __call__(self, frame):
+        return self.fn(frame)
+
+
+_REGISTRY: dict[str, UDF] = {}
+
+
+def register_udf(name: str, fn: Callable, input_col: str, output_col: str) -> UDF:
+    udf = UDF(str(name), fn, input_col, output_col)
+    _REGISTRY[udf.name] = udf
+    return udf
+
+
+def get_udf(name: str) -> UDF:
+    if name not in _REGISTRY:
+        raise KeyError(f"no UDF registered as {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_udfs() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def unregister_udf(name: str) -> None:
+    _REGISTRY.pop(name, None)
