@@ -95,3 +95,19 @@ def test_star_import_and_lazy_api():
     assert sorted(tpudl.__all__) == sorted(set(tpudl.__all__))
     for name in tpudl.__all__:
         assert getattr(tpudl, name) is not None
+
+
+def test_rename_collision_and_concat_schema_mismatch():
+    f = make_frame(3)
+    with pytest.raises(ValueError):
+        f.with_column_renamed("x", "name")
+    with pytest.raises(ValueError):
+        concat([Frame({"a": [1]}), Frame({"a": [2], "b": [3]})])
+
+
+def test_sql_duplicate_alias_raises():
+    from tpudl.frame import sql
+
+    t = Frame({"x": np.arange(3.0), "y": np.arange(3.0)})
+    with pytest.raises(ValueError):
+        sql("SELECT x AS a, y AS a FROM t", {"t": t})

@@ -149,3 +149,13 @@ def test_struct_to_array_writable_by_default(rng):
     arr[0, 0, 0] = 5  # must not raise
     view = io_.imageStructToArray(struct, copy=False)
     assert not view.flags.writeable
+
+
+def test_device_converter_bgra_keeps_alpha(rng):
+    import jax.numpy as jnp
+
+    from tpudl.image import ops
+
+    bgra = rng.integers(0, 255, size=(1, 4, 4, 4)).astype(np.uint8)
+    rgba = np.asarray(ops.sp_image_converter(jnp.asarray(bgra), "BGR", "RGB"))
+    np.testing.assert_array_equal(rgba, bgra[..., [2, 1, 0, 3]].astype(np.float32))

@@ -1,0 +1,128 @@
+"""Zoo numerical-parity tests vs Keras-CPU (the reference-oracle pattern,
+SURVEY.md §4: run the same model both ways on the same inputs, allclose).
+
+Keras builds use weights=None (no network in CI); random weights exercise
+the exact same conversion + arithmetic as pretrained ones. Small input
+sizes keep the oracle cheap; the conversion/naming logic is size-blind.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpudl.zoo import (
+    SUPPORTED_MODELS,
+    getKerasApplicationModel,
+    params_from_keras,
+    preprocess_input,
+    decode_predictions,
+)
+
+keras = pytest.importorskip("keras")
+
+# smallest legal input per architecture (keeps the CPU oracle fast)
+_SMALL = {"InceptionV3": 75, "Xception": 71, "ResNet50": 32, "VGG16": 32,
+          "VGG19": 32}
+
+
+@pytest.fixture(scope="module")
+def x_small(rng):
+    return (rng.normal(size=(2, 1, 1, 3)).astype(np.float32) * 0)  # placeholder
+
+
+def _rand(rng, hw):
+    return (rng.normal(size=(2, hw, hw, 3)) * 50).astype(np.float32)
+
+
+@pytest.mark.parametrize("name", sorted(SUPPORTED_MODELS))
+def test_features_match_keras(name, rng):
+    hw = _SMALL[name]
+    m = getKerasApplicationModel(name)
+    km = m.keras_builder()(weights=None, include_top=False,
+                           input_shape=(hw, hw, 3))
+    params = params_from_keras(km)
+    x = _rand(rng, hw)
+    ref = km.predict(x, verbose=0)
+    ours = np.asarray(m.apply(params, jnp.asarray(x), include_top=False))
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_resnet50_top_matches_keras(rng):
+    m = getKerasApplicationModel("ResNet50")
+    km = m.keras_builder()(weights=None, include_top=True,
+                           input_shape=(64, 64, 3), classes=1000)
+    params = params_from_keras(km)
+    x = _rand(rng, 64)
+    ref = km.predict(x, verbose=0)
+    ours = np.asarray(m.predict(params, jnp.asarray(x)))
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ours.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_vgg16_featurize_is_fc2(rng):
+    m = getKerasApplicationModel("VGG16")
+    km = m.keras_builder()(weights=None, include_top=True,
+                           input_shape=(32, 32, 3), classes=10)
+    sub = keras.Model(km.input, km.get_layer("fc2").output)
+    # our classes param is fixed at 1000; build featurize-only params from
+    # the keras model (predictions layer shape mismatch doesn't matter —
+    # featurize never touches it)
+    params = params_from_keras(km)
+    x = _rand(rng, 32)
+    ref = sub.predict(x, verbose=0)
+    ours = np.asarray(m.featurize(params, jnp.asarray(x)))
+    assert ours.shape == (2, 4096)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_preprocess_parity_tf_and_caffe(rng):
+    from keras.src.applications.imagenet_utils import preprocess_input as kpre
+
+    x = (rng.random(size=(2, 8, 8, 3)) * 255).astype(np.float32)
+    for mode in ("tf", "caffe", "torch"):
+        ref = kpre(x.copy(), data_format="channels_last", mode=mode)
+        ours = np.asarray(preprocess_input(jnp.asarray(x), mode))
+        np.testing.assert_allclose(ours, ref, rtol=1e-6, atol=1e-5)
+
+
+def test_decode_predictions_offline_fallback(rng):
+    preds = rng.random(size=(2, 1000)).astype(np.float32)
+    out = decode_predictions(preds, top=3)
+    assert len(out) == 2 and len(out[0]) == 3
+    top1 = out[0][0]
+    assert top1[2] == pytest.approx(float(preds[0].max()))
+    with pytest.raises(ValueError):
+        decode_predictions(preds[:, :10])
+
+
+def test_init_shapes_match_keras_conversion(rng):
+    import jax
+
+    m = getKerasApplicationModel("ResNet50")
+    params = m.init(jax.random.PRNGKey(0), image_size=(32, 32))
+    km = m.keras_builder()(weights=None, include_top=True,
+                           input_shape=(32, 32, 3), classes=1000)
+    kp = params_from_keras(km)
+    assert set(params) == set(kp)
+    for lname in params:
+        assert set(params[lname]) == set(kp[lname]), lname
+        for k in params[lname]:
+            assert params[lname][k].shape == kp[lname][k].shape, (lname, k)
+
+
+def test_train_mode_returns_bn_updates(rng):
+    import jax
+
+    m = getKerasApplicationModel("ResNet50")
+    params = m.init(jax.random.PRNGKey(0), image_size=(32, 32))
+    x = jnp.asarray(_rand(rng, 32))
+    y, updates = m.apply(params, x, include_top=True, train=True)
+    assert y.shape == (2, 1000)
+    assert updates, "train mode must collect BN moving-stat updates"
+    lname = next(iter(updates))
+    assert set(updates[lname]) == {"moving_mean", "moving_var"}
+    # moving stats must actually move
+    assert not np.allclose(np.asarray(updates[lname]["moving_mean"]),
+                           np.asarray(params[lname]["moving_mean"]))

@@ -89,6 +89,8 @@ class Frame:
         return Frame(out, self.num_partitions)
 
     def with_column_renamed(self, old: str, new: str) -> "Frame":
+        if new != old and new in self._cols:
+            raise ValueError(f"cannot rename {old!r} to existing column {new!r}")
         return Frame(
             {new if k == old else k: v for k, v in self._cols.items()},
             self.num_partitions,
@@ -156,27 +158,26 @@ class Frame:
         fetched and unpadded. This is the rebuild of the reference's
         per-partition TensorFrames MapBlocks execution, minus the JVM.
         """
-        from tpudl import mesh as M
+        if mesh is not None:
+            from tpudl import mesh as M  # jax import only on the mesh path
 
+            multiple = mesh.shape[M.DATA_AXIS]
         missing = [c for c in input_cols if c not in self._cols]
         if missing:
             raise KeyError(f"unknown input columns {missing}")
         outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
-        multiple = mesh.shape[M.DATA_AXIS] if mesh is not None else 1
         for start, stop in self.iter_batches(batch_size):
             packed = []
             for c in input_cols:
                 sl = self._cols[c][start:stop]
                 arr = pack(sl) if pack is not None else _default_pack(sl)
                 packed.append(arr)
-            n_pads = []
+            n_pad = 0
             if mesh is not None:
-                padded = []
-                for arr in packed:
-                    p, n_pad = M.pad_batch(arr, multiple)
-                    padded.append(p)
-                    n_pads.append(n_pad)
-                packed = [M.shard_batch(p, mesh) for p in padded]
+                # every column slices the same rows, so one pad count serves
+                padded = [M.pad_batch(arr, multiple) for arr in packed]
+                n_pad = padded[0][1] if padded else 0
+                packed = [M.shard_batch(p, mesh) for p, _ in padded]
             result = fn(*packed)
             if not isinstance(result, (tuple, list)):
                 result = (result,)
@@ -186,8 +187,8 @@ class Frame:
                 )
             for i, r in enumerate(result):
                 r = np.asarray(r)
-                if n_pads and n_pads[0]:
-                    r = M.unpad_batch(r, n_pads[0])
+                if n_pad:
+                    r = r[: r.shape[0] - n_pad]
                 outputs[i].append(r)
         out = self
         for name, chunks in zip(output_cols, outputs):
@@ -210,6 +211,12 @@ def concat(frames: Sequence[Frame]) -> Frame:
     if not frames:
         raise ValueError("concat of zero frames")
     names = frames[0].columns
+    for i, f in enumerate(frames[1:], start=1):
+        if set(f.columns) != set(names):
+            raise ValueError(
+                f"concat schema mismatch: frame 0 has {names}, "
+                f"frame {i} has {f.columns}"
+            )
     out = {}
     for n in names:
         cols = [f[n] for f in frames]

@@ -56,12 +56,16 @@ def sql(query: str, tables: dict[str, Frame]) -> Frame:
             raise ValueError(f"unsupported select item: {raw!r}")
         if im.group("col"):
             name = im.group("alias") or im.group("col")
+            if name in out:
+                raise ValueError(f"duplicate output column {name!r}")
             out[name] = frame[im.group("col")]
         else:
             from tpudl.udf import registry
 
             fn_name, arg = im.group("fn"), im.group("arg")
             name = im.group("alias") or f"{fn_name}({arg})"
+            if name in out:
+                raise ValueError(f"duplicate output column {name!r}")
             udf = registry.get_udf(fn_name)
             result = udf(frame.select(arg).with_column_renamed(arg, udf.input_col))
             out[name] = result[udf.output_col]

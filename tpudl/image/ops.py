@@ -35,7 +35,10 @@ def sp_image_converter(batch: jax.Array, channel_order_in: str = "BGR",
     x = batch.astype(jnp.float32)
     if channel_order_in != channel_order_out:
         if {channel_order_in, channel_order_out} == {"BGR", "RGB"}:
-            x = x[..., ::-1]
+            if x.shape[-1] == 4:  # BGRA ⇄ RGBA: alpha stays in place
+                x = x[..., jnp.array([2, 1, 0, 3])]
+            else:
+                x = x[..., ::-1]
         elif channel_order_out == "L" or channel_order_in == "L":
             raise ValueError("grayscale conversion must happen at decode time")
         else:

@@ -1,0 +1,7 @@
+from tpudl.zoo.convert import load_keras_model, params_from_keras  # noqa: F401
+from tpudl.zoo.preprocessing import decode_predictions, preprocess_input  # noqa: F401
+from tpudl.zoo.registry import (  # noqa: F401
+    SUPPORTED_MODELS,
+    NamedModel,
+    getKerasApplicationModel,
+)

@@ -1,0 +1,112 @@
+"""Keras → tpudl param-pytree weight conversion.
+
+The TPU-native replacement for the reference's model-loading edge: sparkdl
+ships frozen Keras graphs to executors (transformers/keras_applications.py
+``modelConstructor``/graph export, Scala Models.scala packaged .pb
+resources); we convert the same Keras weights into the zoo's param pytrees
+once on the host, after which everything is pure JAX.
+
+Because zoo param keys are canonical Keras layer names, conversion is a
+mechanical per-layer copy. Layers auto-named by Keras (conv2d_94, ...)
+are re-canonicalized by topological order so conversion works no matter
+how many models the process built before this one.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from tpudl.zoo.core import Namer
+
+__all__ = ["params_from_keras", "load_keras_model"]
+
+_BASE_NAMES = {
+    "Conv2D": "conv2d",
+    "SeparableConv2D": "separable_conv2d",
+    "DepthwiseConv2D": "depthwise_conv2d",
+    "BatchNormalization": "batch_normalization",
+    "Dense": "dense",
+}
+
+
+def _canonical_names(model) -> dict[str, str]:
+    """Map each weighted layer's runtime name → canonical fresh-process name.
+
+    ``model.layers`` is graph-topological (branches interleave), NOT
+    creation order — but Keras's per-type auto-name suffix IS monotone in
+    creation order, so auto-named layers are ranked by suffix and
+    renumbered 0..n-1 per base type. Explicitly-named layers keep their
+    names and (as in Keras) don't consume the counter.
+    """
+    auto: dict[str, list[tuple[int, str]]] = {}
+    mapping: dict[str, str] = {}
+    for layer in model.layers:
+        cls = type(layer).__name__
+        if cls not in _BASE_NAMES or not layer.weights:
+            continue
+        base = _BASE_NAMES[cls]
+        m = re.fullmatch(rf"{base}(?:_(\d+))?", layer.name)
+        if m:
+            auto.setdefault(base, []).append(
+                (int(m.group(1) or 0), layer.name))
+        else:
+            mapping[layer.name] = layer.name
+    namer = Namer()
+    for base, entries in auto.items():
+        for _suffix, runtime_name in sorted(entries):
+            mapping[runtime_name] = namer(base)
+    return mapping
+
+
+def params_from_keras(model) -> dict:
+    """Convert a Keras model's weights → param pytree keyed by canonical
+    layer names (creation-order renumbering, see _canonical_names)."""
+    params: dict[str, dict] = {}
+    names = _canonical_names(model)
+    for layer in model.layers:
+        cls = type(layer).__name__
+        if cls not in _BASE_NAMES or not layer.weights:
+            continue
+        name = names[layer.name]
+        if cls == "Conv2D":
+            p = {"kernel": np.asarray(layer.kernel)}
+            if layer.use_bias:
+                p["bias"] = np.asarray(layer.bias)
+        elif cls == "DepthwiseConv2D":
+            p = {"depthwise_kernel": np.asarray(layer.kernel)}
+            if layer.use_bias:
+                p["bias"] = np.asarray(layer.bias)
+        elif cls == "SeparableConv2D":
+            # Keras 3 SeparableConv2D exposes depthwise/pointwise kernels
+            w = layer.get_weights()
+            p = {"depthwise_kernel": w[0], "pointwise_kernel": w[1]}
+            if layer.use_bias:
+                p["bias"] = w[2]
+        elif cls == "BatchNormalization":
+            p = {
+                "moving_mean": np.asarray(layer.moving_mean),
+                "moving_var": np.asarray(layer.moving_variance),
+            }
+            if layer.center:
+                p["beta"] = np.asarray(layer.beta)
+            if layer.scale:
+                p["gamma"] = np.asarray(layer.gamma)
+        elif cls == "Dense":
+            p = {"kernel": np.asarray(layer.kernel)}
+            if layer.use_bias:
+                p["bias"] = np.asarray(layer.bias)
+        params[name] = p
+    return params
+
+
+def load_keras_model(path_or_model):
+    """Accept a Keras model instance or a path to .keras/.h5 and return the
+    model (TF/Keras used strictly as a loader, never at runtime —
+    SURVEY.md §7.0)."""
+    if hasattr(path_or_model, "layers"):
+        return path_or_model
+    import keras
+
+    return keras.saving.load_model(path_or_model, compile=False)

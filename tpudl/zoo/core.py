@@ -1,0 +1,172 @@
+"""Define-by-run parameter store — the zoo's graph-builder kernel.
+
+Each architecture is written ONCE as a pure build function over a
+``Store``; the same code path (a) initializes a param pytree, (b) applies
+the model in inference mode, and (c) applies it in train mode collecting
+batch-norm moving-stat updates. This replaces the reference's frozen-
+GraphDef composition kernel (ref: sparkdl graph/builder.py —
+IsolatedSession/GraphFunction ~L40-L200): where the reference splices
+protobufs, we compose pure functions that jit into one XLA program.
+
+Param pytrees are keyed by **canonical Keras layer names** (the names a
+freshly-built keras.applications model has in a clean process; the
+``Namer`` reproduces Keras's per-type auto-numbering). That makes Keras
+weight conversion a mechanical per-layer copy (SURVEY.md §7.3 mitigation)
+with no transliteration table.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudl.zoo import nn
+
+__all__ = ["Namer", "Store", "glorot_uniform"]
+
+
+class Namer:
+    """Reproduces Keras auto-naming: first unnamed Conv2D in a fresh process
+    is ``conv2d``, then ``conv2d_1``, ... Per-type counters."""
+
+    def __init__(self):
+        self._counts: dict[str, int] = {}
+
+    def __call__(self, base: str, explicit: str | None = None) -> str:
+        if explicit is not None:
+            return explicit
+        i = self._counts.get(base, 0)
+        self._counts[base] = i + 1
+        return base if i == 0 else f"{base}_{i}"
+
+
+def glorot_uniform(rng, shape, dtype=jnp.float32):
+    """Keras's default kernel initializer."""
+    if len(shape) == 2:
+        fan_in, fan_out = shape
+    else:  # conv HWIO: receptive field × channels
+        rf = int(np.prod(shape[:-2]))
+        fan_in, fan_out = shape[-2] * rf, shape[-1] * rf
+    limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+class Store:
+    """One object, three modes:
+
+    - init:  ``Store(rng=key)`` — layer calls create params, inputs flow
+      through so shapes are inferred from the trace.
+    - apply: ``Store(params=p)`` — layer calls consume params.
+    - train: ``Store(params=p, train=True)`` — BN uses batch stats and
+      updated moving averages accumulate in ``store.bn_updates``.
+    """
+
+    def __init__(self, params=None, rng=None, *, train: bool = False,
+                 param_dtype=jnp.float32):
+        if (params is None) == (rng is None):
+            raise ValueError("pass exactly one of params= (apply) or rng= (init)")
+        self.params = params
+        self.initializing = params is None
+        if self.initializing:
+            self.params = {}
+        self._rng = rng
+        self.train = train and not self.initializing
+        self.param_dtype = param_dtype
+        self.name = Namer()
+        self.bn_updates: dict[str, dict] = {}
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _get(self, name: str, make) -> dict:
+        if self.initializing:
+            if name in self.params:
+                raise ValueError(f"duplicate layer name {name!r}")
+            self.params[name] = make()
+        if name not in self.params:
+            raise KeyError(f"missing params for layer {name!r}")
+        return self.params[name]
+
+    # -- layers (each mirrors the matching Keras layer's weight layout) ----
+    def conv(self, x, filters, kernel_size, *, strides=(1, 1), padding="SAME",
+             use_bias=True, name=None):
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        lname = self.name("conv2d", name)
+        cin = x.shape[-1]
+
+        def make():
+            p = {"kernel": glorot_uniform(self._next_rng(), (kh, kw, cin, filters),
+                                          self.param_dtype)}
+            if use_bias:
+                p["bias"] = jnp.zeros((filters,), self.param_dtype)
+            return p
+
+        p = self._get(lname, make)
+        return nn.conv2d(x, p["kernel"], p.get("bias"), strides=strides,
+                         padding=padding)
+
+    def sep_conv(self, x, filters, kernel_size, *, strides=(1, 1),
+                 padding="SAME", use_bias=True, name=None):
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        lname = self.name("separable_conv2d", name)
+        cin = x.shape[-1]
+
+        def make():
+            p = {
+                "depthwise_kernel": glorot_uniform(
+                    self._next_rng(), (kh, kw, cin, 1), self.param_dtype),
+                "pointwise_kernel": glorot_uniform(
+                    self._next_rng(), (1, 1, cin, filters), self.param_dtype),
+            }
+            if use_bias:
+                p["bias"] = jnp.zeros((filters,), self.param_dtype)
+            return p
+
+        p = self._get(lname, make)
+        return nn.separable_conv2d(x, p["depthwise_kernel"], p["pointwise_kernel"],
+                                   p.get("bias"), strides=strides, padding=padding)
+
+    def bn(self, x, *, scale=True, epsilon=1e-3, momentum=0.99, name=None):
+        lname = self.name("batch_normalization", name)
+        c = x.shape[-1]
+
+        def make():
+            p = {
+                "beta": jnp.zeros((c,), self.param_dtype),
+                "moving_mean": jnp.zeros((c,), self.param_dtype),
+                "moving_var": jnp.ones((c,), self.param_dtype),
+            }
+            if scale:
+                p["gamma"] = jnp.ones((c,), self.param_dtype)
+            return p
+
+        p = self._get(lname, make)
+        if self.train:
+            y, new_stats = nn.batch_norm(x, p, train=True, epsilon=epsilon,
+                                         momentum=momentum)
+            self.bn_updates[lname] = new_stats
+            return y
+        return nn.batch_norm(x, p, train=False, epsilon=epsilon)
+
+    def dense(self, x, units, *, use_bias=True, name=None):
+        lname = self.name("dense", name)
+        cin = x.shape[-1]
+
+        def make():
+            p = {"kernel": glorot_uniform(self._next_rng(), (cin, units),
+                                          self.param_dtype)}
+            if use_bias:
+                p["bias"] = jnp.zeros((units,), self.param_dtype)
+            return p
+
+        p = self._get(lname, make)
+        return nn.dense(x, p["kernel"], p.get("bias"))
+
+    def merged_params(self) -> dict:
+        """Params with train-mode BN moving stats folded back in."""
+        out = dict(self.params)
+        for lname, stats in self.bn_updates.items():
+            out[lname] = {**out[lname], **stats}
+        return out

@@ -1,0 +1,97 @@
+"""ImageNet preprocessing / decode parity.
+
+ref: sparkdl transformers/keras_applications.py — each named model applies
+keras.applications ``preprocess_input`` before the net and
+``decode_predictions`` after (DeepImagePredictor topK path,
+named_image.py ~L120). These are the classic silent-mismatch spots
+(SURVEY.md §7.3 hard part #1), so modes are implemented explicitly:
+
+- ``tf``    : x/127.5 - 1, RGB input            (InceptionV3, Xception)
+- ``caffe`` : RGB→BGR, subtract ImageNet means  (ResNet50, VGG16, VGG19)
+- ``torch`` : x/255 then per-channel mean/std   (not used by the zoo, kept
+              for API parity)
+
+All fns are jittable and assume float input in [0, 255] **RGB** channel
+order (convert from BGR storage first via tpudl.image.ops).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["preprocess_input", "decode_predictions", "CAFFE_MEANS_BGR"]
+
+CAFFE_MEANS_BGR = (103.939, 116.779, 123.68)
+_TORCH_MEAN = (0.485, 0.456, 0.406)
+_TORCH_STD = (0.229, 0.224, 0.225)
+
+
+def preprocess_input(x, mode: str = "caffe"):
+    """x: (..., H, W, 3) float, RGB, values in [0, 255]."""
+    if mode == "tf":
+        return x / 127.5 - 1.0
+    if mode == "caffe":
+        bgr = x[..., ::-1]
+        return bgr - jnp.asarray(CAFFE_MEANS_BGR, dtype=x.dtype)
+    if mode == "torch":
+        x = x / 255.0
+        return (x - jnp.asarray(_TORCH_MEAN, x.dtype)) / jnp.asarray(
+            _TORCH_STD, x.dtype)
+    raise ValueError(f"unknown preprocess mode {mode!r}")
+
+
+_CLASS_INDEX = None
+
+
+def _load_class_index():
+    """ImageNet class index: {str(idx): [wnid, label]}.
+
+    Looked up from (in order) $TPUDL_IMAGENET_CLASS_INDEX, the keras cache
+    (~/.keras/models/imagenet_class_index.json). This sandbox has no
+    network, so absent a local file we degrade to index-only labels.
+    """
+    global _CLASS_INDEX
+    if _CLASS_INDEX is not None:
+        return _CLASS_INDEX
+    candidates = [
+        os.environ.get("TPUDL_IMAGENET_CLASS_INDEX", ""),
+        os.path.expanduser("~/.keras/models/imagenet_class_index.json"),
+    ]
+    for path in candidates:
+        if path and os.path.exists(path):
+            with open(path) as f:
+                _CLASS_INDEX = json.load(f)
+            return _CLASS_INDEX
+    _CLASS_INDEX = {}
+    return _CLASS_INDEX
+
+
+def decode_predictions(preds, top: int = 5):
+    """(B, 1000) scores → per-row list of (wnid, label, score) topK.
+
+    Matches keras.applications.imagenet_utils.decode_predictions; when no
+    class-index file is available offline, wnid/label fall back to
+    ``class_<idx>``.
+    """
+    preds = np.asarray(preds)
+    if preds.ndim != 2 or preds.shape[1] != 1000:
+        raise ValueError(
+            f"decode_predictions expects (batch, 1000) scores, got {preds.shape}"
+        )
+    index = _load_class_index()
+    results = []
+    for row in preds:
+        top_idx = row.argsort()[-top:][::-1]
+        entries = []
+        for i in top_idx:
+            if str(i) in index:
+                wnid, label = index[str(i)]
+            else:
+                wnid, label = f"class_{i}", f"class_{i}"
+            entries.append((wnid, label, float(row[i])))
+        results.append(entries)
+    return results

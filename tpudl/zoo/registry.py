@@ -1,0 +1,114 @@
+"""Named pretrained-model registry — the zoo's public surface.
+
+TPU-native rebuild of sparkdl's named-model registry
+(ref: python/sparkdl/transformers/keras_applications.py —
+KerasApplicationModel base ~L30, InceptionV3Model/XceptionModel/
+ResNet50Model/VGG16Model/VGG19Model ~L60-200, getKerasApplicationModel;
+JVM twin src/main/scala/com/databricks/sparkdl/Models.scala). Each entry
+couples architecture, input geometry, preprocessing mode, and featurize
+semantics (penultimate-layer output, like the reference's graph cut).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.zoo import inception_v3, resnet, vgg, xception
+from tpudl.zoo.core import Store
+from tpudl.zoo.preprocessing import preprocess_input
+
+__all__ = ["NamedModel", "SUPPORTED_MODELS", "getKerasApplicationModel"]
+
+
+@dataclasses.dataclass(frozen=True)
+class NamedModel:
+    name: str
+    build_fn: Callable
+    input_size: tuple[int, int]
+    feature_dim: int
+    preprocess_mode: str
+    classes: int = 1000
+
+    # -- params ----------------------------------------------------------
+    def init(self, rng, *, image_size: tuple[int, int] | None = None,
+             include_top: bool = True) -> dict:
+        """Random-init param pytree (Keras initializers), traced under jit
+        so init costs one compile, not one eager forward."""
+        h, w = image_size or self.input_size
+
+        def _init(key):
+            s = Store(rng=key)
+            self.build_fn(s, jnp.zeros((1, h, w, 3), jnp.float32),
+                          include_top=include_top, classes=self.classes)
+            return s.params
+
+        return jax.jit(_init)(rng)
+
+    # -- pure apply fns (jit at call sites) ------------------------------
+    def apply(self, params: dict, x, *, include_top=True, pooling=None,
+              train: bool = False):
+        """Forward pass. x: float RGB in [0,255] BEFORE preprocessing is
+        NOT assumed — caller preprocesses (see preprocess)."""
+        s = Store(params=params, train=train)
+        y = self.build_fn(s, x, include_top=include_top, pooling=pooling,
+                          classes=self.classes)
+        if train:
+            return y, s.bn_updates
+        return y
+
+    def preprocess(self, x):
+        """float RGB [0,255] → model input domain."""
+        return preprocess_input(x, self.preprocess_mode)
+
+    def featurize(self, params: dict, x):
+        """Penultimate-layer features (the DeepImageFeaturizer vector)."""
+        s = Store(params=params)
+        if self.build_fn in (vgg.build_vgg16, vgg.build_vgg19):
+            return self.build_fn(s, x, include_top="features")
+        return self.build_fn(s, x, include_top=False, pooling="avg")
+
+    def predict(self, params: dict, x):
+        """Softmax class scores (the DeepImagePredictor path)."""
+        return self.apply(params, x, include_top=True)
+
+    def keras_builder(self):
+        """The matching keras.applications constructor (loader-only use:
+        pretrained-weight conversion and parity tests)."""
+        import keras
+
+        return {
+            "InceptionV3": keras.applications.InceptionV3,
+            "Xception": keras.applications.Xception,
+            "ResNet50": keras.applications.ResNet50,
+            "VGG16": keras.applications.VGG16,
+            "VGG19": keras.applications.VGG19,
+        }[self.name]
+
+
+SUPPORTED_MODELS: dict[str, NamedModel] = {
+    m.name: m
+    for m in [
+        NamedModel("InceptionV3", inception_v3.build, inception_v3.INPUT_SIZE,
+                   inception_v3.FEATURE_DIM, inception_v3.PREPROCESS_MODE),
+        NamedModel("Xception", xception.build, xception.INPUT_SIZE,
+                   xception.FEATURE_DIM, xception.PREPROCESS_MODE),
+        NamedModel("ResNet50", resnet.build, resnet.INPUT_SIZE,
+                   resnet.FEATURE_DIM, resnet.PREPROCESS_MODE),
+        NamedModel("VGG16", vgg.build_vgg16, vgg.INPUT_SIZE, 4096,
+                   vgg.PREPROCESS_MODE),
+        NamedModel("VGG19", vgg.build_vgg19, vgg.INPUT_SIZE, 4096,
+                   vgg.PREPROCESS_MODE),
+    ]
+}
+
+
+def getKerasApplicationModel(name: str) -> NamedModel:
+    if name not in SUPPORTED_MODELS:
+        raise ValueError(
+            f"unsupported model {name!r}; supported: {sorted(SUPPORTED_MODELS)}"
+        )
+    return SUPPORTED_MODELS[name]

@@ -1,0 +1,78 @@
+"""ResNet50 (v1) as a pure JAX build function.
+
+Architecture follows keras.applications.resnet.ResNet50 exactly, with the
+stable semantic Keras layer names (conv1_conv, conv2_block1_1_conv, ...)
+as param keys. Reference consumer: sparkdl transformers/
+keras_applications.py ResNet50Model (~L120) — 224×224 input, 'caffe'
+preprocessing, 2048-d featurize vector. Also the HorovodRunner training
+config (BASELINE.json configs[3]) — train mode exercises BN batch stats.
+
+Conv/BN details from the Keras source: conv1 is 7×7 s2 VALID after a
+(3,3) zero-pad, all convs use bias, BN epsilon 1.001e-5; stacks
+conv2(64×3, s1), conv3(128×4, s2), conv4(256×6, s2), conv5(512×3, s2);
+block shortcut is a 1×1 VALID conv at stride s.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpudl.zoo import nn
+from tpudl.zoo.core import Store
+
+NAME = "ResNet50"
+INPUT_SIZE = (224, 224)
+FEATURE_DIM = 2048
+PREPROCESS_MODE = "caffe"
+
+_EPS = 1.001e-5
+
+
+def _block(s: Store, x, filters, *, stride=1, conv_shortcut=True, name=""):
+    if conv_shortcut:
+        shortcut = s.conv(x, 4 * filters, 1, strides=(stride, stride),
+                          padding="VALID", name=f"{name}_0_conv")
+        shortcut = s.bn(shortcut, epsilon=_EPS, name=f"{name}_0_bn")
+    else:
+        shortcut = x
+    x = s.conv(x, filters, 1, strides=(stride, stride), padding="VALID",
+               name=f"{name}_1_conv")
+    x = s.bn(x, epsilon=_EPS, name=f"{name}_1_bn")
+    x = nn.relu(x)
+    x = s.conv(x, filters, 3, padding="SAME", name=f"{name}_2_conv")
+    x = s.bn(x, epsilon=_EPS, name=f"{name}_2_bn")
+    x = nn.relu(x)
+    x = s.conv(x, 4 * filters, 1, padding="VALID", name=f"{name}_3_conv")
+    x = s.bn(x, epsilon=_EPS, name=f"{name}_3_bn")
+    return nn.relu(shortcut + x)
+
+
+def _stack(s: Store, x, filters, blocks, *, stride1=2, name=""):
+    x = _block(s, x, filters, stride=stride1, name=f"{name}_block1")
+    for i in range(2, blocks + 1):
+        x = _block(s, x, filters, conv_shortcut=False, name=f"{name}_block{i}")
+    return x
+
+
+def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    x = nn.zero_pad(x, ((3, 3), (3, 3)))
+    x = s.conv(x, 64, 7, strides=(2, 2), padding="VALID", name="conv1_conv")
+    x = s.bn(x, epsilon=_EPS, name="conv1_bn")
+    x = nn.relu(x)
+    x = nn.zero_pad(x, ((1, 1), (1, 1)))
+    x = nn.max_pool(x, (3, 3), strides=(2, 2))
+
+    x = _stack(s, x, 64, 3, stride1=1, name="conv2")
+    x = _stack(s, x, 128, 4, name="conv3")
+    x = _stack(s, x, 256, 6, name="conv4")
+    x = _stack(s, x, 512, 3, name="conv5")
+
+    if include_top:
+        x = nn.global_avg_pool(x)
+        x = s.dense(x, classes, name="predictions")
+        return nn.softmax(x)
+    if pooling == "avg":
+        return nn.global_avg_pool(x)
+    if pooling == "max":
+        return nn.global_max_pool(x)
+    return x

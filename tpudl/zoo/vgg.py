@@ -1,0 +1,52 @@
+"""VGG16/VGG19 as pure JAX build functions.
+
+Architecture follows keras.applications.vgg16/vgg19 exactly (3×3 SAME
+convs with bias + relu, 2×2 maxpools, fc1/fc2 4096). Reference consumer:
+sparkdl transformers/keras_applications.py VGG16Model/VGG19Model (~L150) —
+224×224 input, 'caffe' preprocessing.
+"""
+
+from __future__ import annotations
+
+from tpudl.zoo import nn
+from tpudl.zoo.core import Store
+
+INPUT_SIZE = (224, 224)
+PREPROCESS_MODE = "caffe"
+
+_VGG16_BLOCKS = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+_VGG19_BLOCKS = [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]
+
+
+def _build(s: Store, x, blocks, *, include_top, pooling=None, classes=1000):
+    for b, (filters, convs) in enumerate(blocks, start=1):
+        for c in range(1, convs + 1):
+            x = s.conv(x, filters, 3, padding="SAME", name=f"block{b}_conv{c}")
+            x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+    if include_top == "features":
+        # the DeepImageFeaturizer cut for VGG: post-relu fc2 (4096-d)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(s.dense(x, 4096, name="fc1"))
+        return nn.relu(s.dense(x, 4096, name="fc2"))
+    if include_top:
+        x = x.reshape(x.shape[0], -1)  # Keras Flatten (NHWC row-major)
+        x = nn.relu(s.dense(x, 4096, name="fc1"))
+        x = nn.relu(s.dense(x, 4096, name="fc2"))
+        x = s.dense(x, classes, name="predictions")
+        return nn.softmax(x)
+    if pooling == "avg":
+        return nn.global_avg_pool(x)
+    if pooling == "max":
+        return nn.global_max_pool(x)
+    return x
+
+
+def build_vgg16(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    return _build(s, x, _VGG16_BLOCKS, include_top=include_top,
+                  pooling=pooling, classes=classes)
+
+
+def build_vgg19(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    return _build(s, x, _VGG19_BLOCKS, include_top=include_top,
+                  pooling=pooling, classes=classes)

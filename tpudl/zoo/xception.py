@@ -1,0 +1,96 @@
+"""Xception as a pure JAX build function.
+
+Architecture follows keras.applications.xception exactly. Sepconv/bn
+layers carry their stable Keras names; the four residual-projection convs
+and their BNs are unnamed in the Keras source → canonical auto names
+(conv2d/conv2d_N, batch_normalization/batch_normalization_N). Reference
+consumer: sparkdl transformers/keras_applications.py XceptionModel (~L90)
+— 299×299 input, 'tf' preprocessing, 2048-d featurize vector.
+"""
+
+from __future__ import annotations
+
+from tpudl.zoo import nn
+from tpudl.zoo.core import Store
+
+NAME = "Xception"
+INPUT_SIZE = (299, 299)
+FEATURE_DIM = 2048
+PREPROCESS_MODE = "tf"
+
+
+def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    x = s.conv(x, 32, 3, strides=(2, 2), padding="VALID", use_bias=False,
+               name="block1_conv1")
+    x = s.bn(x, name="block1_conv1_bn")
+    x = nn.relu(x)
+    x = s.conv(x, 64, 3, padding="VALID", use_bias=False, name="block1_conv2")
+    x = s.bn(x, name="block1_conv2_bn")
+    x = nn.relu(x)
+
+    for i, filters in enumerate((128, 256, 728)):
+        residual = s.conv(x, filters, 1, strides=(2, 2), padding="SAME",
+                          use_bias=False)
+        residual = s.bn(residual)
+        block = f"block{i + 2}"
+        if i > 0:
+            x = nn.relu(x)
+        x = s.sep_conv(x, filters, 3, padding="SAME", use_bias=False,
+                       name=f"{block}_sepconv1")
+        x = s.bn(x, name=f"{block}_sepconv1_bn")
+        x = nn.relu(x)
+        x = s.sep_conv(x, filters, 3, padding="SAME", use_bias=False,
+                       name=f"{block}_sepconv2")
+        x = s.bn(x, name=f"{block}_sepconv2_bn")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = x + residual
+
+    for i in range(8):
+        block = f"block{i + 5}"
+        residual = x
+        x = nn.relu(x)
+        x = s.sep_conv(x, 728, 3, padding="SAME", use_bias=False,
+                       name=f"{block}_sepconv1")
+        x = s.bn(x, name=f"{block}_sepconv1_bn")
+        x = nn.relu(x)
+        x = s.sep_conv(x, 728, 3, padding="SAME", use_bias=False,
+                       name=f"{block}_sepconv2")
+        x = s.bn(x, name=f"{block}_sepconv2_bn")
+        x = nn.relu(x)
+        x = s.sep_conv(x, 728, 3, padding="SAME", use_bias=False,
+                       name=f"{block}_sepconv3")
+        x = s.bn(x, name=f"{block}_sepconv3_bn")
+        x = x + residual
+
+    residual = s.conv(x, 1024, 1, strides=(2, 2), padding="SAME",
+                      use_bias=False)
+    residual = s.bn(residual)
+    x = nn.relu(x)
+    x = s.sep_conv(x, 728, 3, padding="SAME", use_bias=False,
+                   name="block13_sepconv1")
+    x = s.bn(x, name="block13_sepconv1_bn")
+    x = nn.relu(x)
+    x = s.sep_conv(x, 1024, 3, padding="SAME", use_bias=False,
+                   name="block13_sepconv2")
+    x = s.bn(x, name="block13_sepconv2_bn")
+    x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+    x = x + residual
+
+    x = s.sep_conv(x, 1536, 3, padding="SAME", use_bias=False,
+                   name="block14_sepconv1")
+    x = s.bn(x, name="block14_sepconv1_bn")
+    x = nn.relu(x)
+    x = s.sep_conv(x, 2048, 3, padding="SAME", use_bias=False,
+                   name="block14_sepconv2")
+    x = s.bn(x, name="block14_sepconv2_bn")
+    x = nn.relu(x)
+
+    if include_top:
+        x = nn.global_avg_pool(x)
+        x = s.dense(x, classes, name="predictions")
+        return nn.softmax(x)
+    if pooling == "avg":
+        return nn.global_avg_pool(x)
+    if pooling == "max":
+        return nn.global_max_pool(x)
+    return x
