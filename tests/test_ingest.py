@@ -1,0 +1,239 @@
+"""Factory-matrix tests for tpudl.ingest — the rebuild of the reference's
+`python/tests/graph/test_import.py` (SURVEY.md §4): every TFInputGraph
+construction route over the same tiny graph, each asserted against the
+local TF oracle; plus Keras frozen/trainable ingestion vs model.predict,
+and op-coverage for a small CNN.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+
+from tpudl.ingest import TFInputGraph, UnsupportedOpError, build_jax_fn  # noqa: E402
+
+
+def _tiny_v1_graph():
+    """z = w*x + b with w,b Variables (the reference's 3x+4 pattern)."""
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float64, shape=[None, 3], name="x")
+        w = tf.compat.v1.get_variable(
+            "w", dtype=tf.float64, initializer=np.float64(3.0))
+        b = tf.compat.v1.get_variable(
+            "b", dtype=tf.float64, initializer=np.float64(4.0))
+        z = tf.add(tf.multiply(x, w), b, name="z")
+    return g, x, z
+
+
+@pytest.fixture(scope="module")
+def xval(rng):
+    return np.asarray(np.random.default_rng(7).normal(size=(5, 3)))
+
+
+@pytest.fixture(scope="module")
+def oracle(xval):
+    g, x, z = _tiny_v1_graph()
+    with tf.compat.v1.Session(graph=g) as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        return sess.run(z, {x: xval})
+
+
+def _check(gin, xval, oracle):
+    fn = jax.jit(gin.make_fn())
+    out = np.asarray(fn(xval))
+    np.testing.assert_allclose(out, oracle, rtol=1e-6)
+
+
+def test_from_graph(xval, oracle):
+    g, x, z = _tiny_v1_graph()
+    with tf.compat.v1.Session(graph=g) as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        gin = TFInputGraph.fromGraph(g, sess, ["x:0"], ["z:0"])
+    _check(gin, xval, oracle)
+
+
+def test_from_graph_def(xval, oracle):
+    g, x, z = _tiny_v1_graph()
+    with tf.compat.v1.Session(graph=g) as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        gdef = tf.compat.v1.graph_util.convert_variables_to_constants(
+            sess, g.as_graph_def(), ["z"])
+    gin = TFInputGraph.fromGraphDef(gdef, ["x"], ["z"])
+    _check(gin, xval, oracle)
+
+
+@pytest.fixture(scope="module")
+def saved_model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("sm") / "model")
+    g, x, z = _tiny_v1_graph()
+    with tf.compat.v1.Session(graph=g) as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        builder = tf.compat.v1.saved_model.builder.SavedModelBuilder(d)
+        sig = tf.compat.v1.saved_model.signature_def_utils.predict_signature_def(
+            inputs={"input_sig": x}, outputs={"output_sig": z})
+        builder.add_meta_graph_and_variables(
+            sess, ["serve"], signature_def_map={"my_sig": sig})
+        builder.save()
+    return d
+
+
+def test_from_saved_model(saved_model_dir, xval, oracle):
+    gin = TFInputGraph.fromSavedModel(saved_model_dir, "serve", ["x:0"], ["z:0"])
+    _check(gin, xval, oracle)
+
+
+def test_from_saved_model_with_signature(saved_model_dir, xval, oracle):
+    gin = TFInputGraph.fromSavedModelWithSignature(saved_model_dir, "serve",
+                                                   "my_sig")
+    assert gin.input_tensor_name_from_signature == {"input_sig": "x:0"}
+    assert gin.output_tensor_name_from_signature == {"output_sig": "z:0"}
+    _check(gin, xval, oracle)
+
+
+@pytest.fixture(scope="module")
+def checkpoint_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("ckpt"))
+    g, x, z = _tiny_v1_graph()
+    with g.as_default(), tf.compat.v1.Session(graph=g) as sess:
+        sess.run(tf.compat.v1.global_variables_initializer())
+        sig = tf.compat.v1.saved_model.signature_def_utils.predict_signature_def(
+            inputs={"input_sig": x}, outputs={"output_sig": z})
+        saver = tf.compat.v1.train.Saver()
+        saver.save(sess, d + "/model")
+        # stash the signature in the exported meta graph, reference-style
+        meta = tf.compat.v1.train.export_meta_graph(
+            saver_def=saver.as_saver_def())
+        meta.signature_def["my_sig"].CopyFrom(sig)
+        with open(d + "/model.meta", "wb") as f:
+            f.write(meta.SerializeToString())
+    return d
+
+
+def test_from_checkpoint(checkpoint_dir, xval, oracle):
+    gin = TFInputGraph.fromCheckpoint(checkpoint_dir, ["x:0"], ["z:0"])
+    _check(gin, xval, oracle)
+
+
+def test_from_checkpoint_with_signature(checkpoint_dir, xval, oracle):
+    gin = TFInputGraph.fromCheckpointWithSignature(checkpoint_dir, "my_sig")
+    assert gin.output_tensor_name_from_signature == {"output_sig": "z:0"}
+    _check(gin, xval, oracle)
+
+
+# -- Keras routes ----------------------------------------------------------
+@pytest.fixture(scope="module")
+def keras_mlp():
+    import keras
+
+    keras.utils.set_random_seed(0)
+    return keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+
+
+def test_from_keras_frozen(keras_mlp):
+    x = np.random.default_rng(0).normal(size=(6, 4)).astype(np.float32)
+    want = keras_mlp.predict(x, verbose=0)
+    gin = TFInputGraph.fromKeras(keras_mlp)
+    got = np.asarray(jax.jit(gin.make_fn())(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_from_keras_file(keras_mlp, tmp_path):
+    path = str(tmp_path / "m.keras")
+    keras_mlp.save(path)
+    x = np.random.default_rng(1).normal(size=(2, 4)).astype(np.float32)
+    gin = TFInputGraph.fromKeras(path)
+    got = np.asarray(jax.jit(gin.make_fn())(x))
+    np.testing.assert_allclose(got, keras_mlp.predict(x, verbose=0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_from_keras_trainable_matches_and_differentiates(keras_mlp):
+    x = np.random.default_rng(2).normal(size=(6, 4)).astype(np.float32)
+    gin = TFInputGraph.fromKerasTrainable(keras_mlp)
+    assert gin.trainable and set(gin.params)
+    fn = gin.make_fn()
+    got = np.asarray(jax.jit(fn)(gin.params, x))
+    np.testing.assert_allclose(got, keras_mlp.predict(x, verbose=0),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss(params):
+        return fn(params, x).sum()
+
+    grads = jax.grad(loss)(gin.params)
+    # every param leaf gets a finite gradient of its own shape
+    for k, g in grads.items():
+        assert np.asarray(g).shape == gin.params[k].shape
+        assert np.isfinite(np.asarray(g)).all()
+    # bias grads of the last layer under sum-of-softmax ≈ 0 is NOT expected
+    # to be exactly zero; just require some signal somewhere:
+    total = sum(float(np.abs(np.asarray(g)).sum()) for g in grads.values())
+    assert total > 0
+
+
+def test_keras_cnn_op_coverage():
+    """Conv2D/DepthwiseConv2D/BN/pooling/flatten through the translator."""
+    import keras
+
+    keras.utils.set_random_seed(0)
+    m = keras.Sequential([
+        keras.layers.Input((16, 16, 3)),
+        keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.DepthwiseConv2D(3, padding="same"),
+        keras.layers.AveragePooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5),
+    ])
+    x = np.random.default_rng(3).normal(size=(2, 16, 16, 3)).astype(np.float32)
+    gin = TFInputGraph.fromKeras(m)
+    got = np.asarray(jax.jit(gin.make_fn())(x))
+    np.testing.assert_allclose(got, m.predict(x, verbose=0), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_depthwise_multiplier_channel_order():
+    """depth_multiplier>1: TF channel order is c-major — regression for the
+    kernel-layout translation."""
+    import keras
+
+    keras.utils.set_random_seed(1)
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.DepthwiseConv2D(3, depth_multiplier=2, padding="same"),
+    ])
+    x = np.random.default_rng(4).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    gin = TFInputGraph.fromKeras(m)
+    got = np.asarray(jax.jit(gin.make_fn())(x))
+    np.testing.assert_allclose(got, m.predict(x, verbose=0), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_unsupported_op_reports_name():
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, shape=[2, 2], name="x")
+        y = tf.raw_ops.MatrixInverse(input=x, name="inv")
+    gin = TFInputGraph.fromGraphDef(g.as_graph_def(), ["x"], ["inv"])
+    with pytest.raises(UnsupportedOpError, match="MatrixInverse"):
+        gin.make_fn()(np.eye(2, dtype=np.float32))
+
+
+def test_build_jax_fn_direct_partial_fetch():
+    """Lazy pruning: fetching an intermediate skips downstream ops."""
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, shape=[3], name="x")
+        mid = tf.nn.relu(x, name="mid")
+        _bad = tf.raw_ops.MatrixInverse(
+            input=tf.reshape(tf.tile(mid, [3]), (3, 3)), name="bad")
+    fn = build_jax_fn(g.as_graph_def(), ["x"], ["mid"])
+    out = np.asarray(fn(np.array([-1.0, 0.0, 2.0], np.float32)))
+    np.testing.assert_array_equal(out, [0.0, 0.0, 2.0])

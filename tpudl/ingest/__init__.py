@@ -1,0 +1,12 @@
+"""Model ingestion: TF/Keras artifacts → jittable XLA programs.
+
+Rebuild of the reference's graph toolkit + ingester (ref: sparkdl
+graph/input.py, graph/builder.py, graph/utils.py) — see
+:mod:`tpudl.ingest.input` for the factory matrix and
+:mod:`tpudl.ingest.graphdef` for the GraphDef→JAX translator.
+"""
+
+from tpudl.ingest.graphdef import UnsupportedOpError, build_jax_fn
+from tpudl.ingest.input import TFInputGraph
+
+__all__ = ["TFInputGraph", "build_jax_fn", "UnsupportedOpError"]

@@ -1,0 +1,755 @@
+"""GraphDef → JAX interpreter: TF graphs become jittable XLA programs.
+
+This is the execution half of the rebuild of sparkdl's model-ingestion
+layer (ref: python/sparkdl/graph/input.py — TFInputGraph ~L40 and its
+factory matrix ~L80-350). The reference ships frozen GraphDefs to a TF
+C++ session on each executor; here the graph is *translated once* into a
+pure jax function — closed over constants, parameterized over variables —
+which then jits into a single fused XLA:TPU program. TF is used strictly
+as a proto/loader library (SURVEY.md §7.0), never at runtime.
+
+Two modes:
+- frozen:    every variable already constant-folded → ``fn(*feeds)``.
+- trainable: resource placeholders map to a params pytree →
+  ``fn(params, *feeds)`` — differentiable with ``jax.grad`` through the
+  whole ingested model, a capability the reference's frozen-protobuf
+  design structurally ruled out.
+
+Op coverage targets what TF2/Keras tracing and TF1 freezing actually emit
+for MLPs/CNNs (the reference's model space, SURVEY.md §5.7). Unsupported
+ops raise ``UnsupportedOpError`` naming the op, at *translation* time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["UnsupportedOpError", "build_jax_fn", "tensor_name", "op_name"]
+
+
+class UnsupportedOpError(NotImplementedError):
+    def __init__(self, op: str, node: str):
+        super().__init__(
+            f"GraphDef op {op!r} (node {node!r}) has no JAX translation; "
+            "supported ops are the TF2/Keras inference set — see "
+            "tpudl/ingest/graphdef.py:_OPS"
+        )
+        self.op = op
+
+
+# -- tensor-name algebra (ref: sparkdl graph/utils.py as_op_name/as_tensor_name)
+def tensor_name(name: str) -> str:
+    """Canonicalize ``"x"`` → ``"x:0"`` (graph-output tensor form)."""
+    name = name.lstrip("^")
+    return name if ":" in name else name + ":0"
+
+
+def op_name(name: str) -> str:
+    """Canonicalize ``"x:0"`` → ``"x"`` (op/node form)."""
+    name = name.lstrip("^")
+    return name.split(":")[0]
+
+
+def _np_dtype(tf_enum: int):
+    import tensorflow as tf
+
+    return np.dtype(tf.dtypes.DType(tf_enum).as_numpy_dtype)
+
+
+def _const_value(node):
+    import tensorflow as tf
+
+    return tf.make_ndarray(node.attr["value"].tensor)
+
+
+def _attr_list(attr):
+    return list(attr.list.i) or list(attr.list.f) or list(attr.list.s)
+
+
+def _static_or_np(x):
+    """Concrete numpy value of ``x`` if available (Const-fed inputs under
+    tracing), else None. Shape-like operands must be static for XLA."""
+    if isinstance(x, (np.ndarray, np.generic, int, float, list, tuple)):
+        return np.asarray(x)
+    if isinstance(x, jax.core.Tracer):
+        return None
+    if isinstance(x, jax.Array):
+        return np.asarray(x)
+    return None
+
+
+def _require_static(x, node, what):
+    v = _static_or_np(x)
+    if v is None:
+        raise UnsupportedOpError(
+            f"dynamic {what}", f"{node.name} (shape-like operands must be "
+            "constants for XLA static shapes)")
+    return v
+
+
+# ---------------------------------------------------------------------------
+# op handlers: (node, inputs: list[jnp], ctx) -> value or tuple of values
+# ---------------------------------------------------------------------------
+_OPS = {}
+
+
+def op(*names):
+    def deco(fn):
+        for n in names:
+            _OPS[n] = fn
+        return fn
+    return deco
+
+
+def _unary(fn):
+    return lambda node, xs, ctx: fn(xs[0])
+
+
+def _binary(fn):
+    return lambda node, xs, ctx: fn(xs[0], xs[1])
+
+
+for _name, _fn in {
+    "Relu": jax.nn.relu, "Relu6": lambda x: jnp.clip(x, 0, 6),
+    "Elu": jax.nn.elu, "Selu": jax.nn.selu, "Softplus": jax.nn.softplus,
+    "Softsign": jax.nn.soft_sign, "Sigmoid": jax.nn.sigmoid,
+    "Tanh": jnp.tanh, "Exp": jnp.exp, "Log": jnp.log, "Log1p": jnp.log1p,
+    "Sqrt": jnp.sqrt, "Rsqrt": lax.rsqrt, "Square": jnp.square,
+    "Neg": jnp.negative, "Abs": jnp.abs, "Sign": jnp.sign,
+    "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
+    "Erf": lax.erf, "Sin": jnp.sin, "Cos": jnp.cos,
+    "Reciprocal": jnp.reciprocal, "LogicalNot": jnp.logical_not,
+    "Identity": lambda x: x, "StopGradient": lax.stop_gradient,
+    "ZerosLike": jnp.zeros_like, "OnesLike": jnp.ones_like,
+    "Snapshot": lambda x: x,
+}.items():
+    _OPS[_name] = _unary(_fn)
+
+for _name, _fn in {
+    "Add": jnp.add, "AddV2": jnp.add, "Sub": jnp.subtract,
+    "Mul": jnp.multiply, "RealDiv": jnp.divide, "Div": jnp.divide,
+    "DivNoNan": lambda x, y: jnp.where(y == 0, 0, x / jnp.where(y == 0, 1, y)),
+    "FloorDiv": jnp.floor_divide, "FloorMod": jnp.mod, "Mod": jnp.mod,
+    "Pow": jnp.power, "Maximum": jnp.maximum, "Minimum": jnp.minimum,
+    "SquaredDifference": lambda x, y: jnp.square(x - y),
+    "Greater": jnp.greater, "GreaterEqual": jnp.greater_equal,
+    "Less": jnp.less, "LessEqual": jnp.less_equal,
+    "Equal": jnp.equal, "NotEqual": jnp.not_equal,
+    "LogicalAnd": jnp.logical_and, "LogicalOr": jnp.logical_or,
+    "BitwiseAnd": jnp.bitwise_and, "BitwiseOr": jnp.bitwise_or,
+    "LeftShift": jnp.left_shift, "RightShift": jnp.right_shift,
+}.items():
+    _OPS[_name] = _binary(_fn)
+
+
+@op("Const")
+def _const(node, xs, ctx):
+    return _const_value(node)
+
+
+@op("NoOp", "Assert", "PreventGradient", "CheckNumerics")
+def _noop(node, xs, ctx):
+    return xs[0] if xs else None
+
+
+@op("ReadVariableOp")
+def _read_var(node, xs, ctx):
+    return xs[0]  # resource input already resolved to the variable's value
+
+
+@op("Cast")
+def _cast(node, xs, ctx):
+    return xs[0].astype(_np_dtype(node.attr["DstT"].type)) if hasattr(
+        xs[0], "astype") else np.asarray(xs[0], _np_dtype(node.attr["DstT"].type))
+
+
+@op("AddN")
+def _addn(node, xs, ctx):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@op("MatMul")
+def _matmul(node, xs, ctx):
+    a, b = xs
+    if node.attr["transpose_a"].b:
+        a = a.T
+    if node.attr["transpose_b"].b:
+        b = b.T
+    return a @ b
+
+
+@op("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+def _batch_matmul(node, xs, ctx):
+    a, b = xs
+    if node.attr["adj_x"].b:
+        a = jnp.swapaxes(a, -1, -2)
+    if node.attr["adj_y"].b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@op("Einsum")
+def _einsum(node, xs, ctx):
+    eq = node.attr["equation"].s.decode()
+    return jnp.einsum(eq, *xs)
+
+
+@op("BiasAdd")
+def _bias_add(node, xs, ctx):
+    x, b = xs
+    if node.attr["data_format"].s == b"NCHW":
+        return x + b.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return x + b
+
+
+def _nhwc(node, x):
+    """Return (x_nhwc, was_nchw) normalizing data_format."""
+    fmt = node.attr["data_format"].s or b"NHWC"
+    if fmt == b"NCHW":
+        return jnp.transpose(x, (0, 2, 3, 1)), True
+    return x, False
+
+
+def _from_nhwc(y, was_nchw):
+    return jnp.transpose(y, (0, 3, 1, 2)) if was_nchw else y
+
+
+def _conv_padding(node):
+    pad = node.attr["padding"].s.decode()
+    if pad == "EXPLICIT":
+        # explicit_paddings pairs are in data-format order; extract spatial
+        ep = list(node.attr["explicit_paddings"].list.i)
+        if node.attr["data_format"].s == b"NCHW":
+            return [(ep[4], ep[5]), (ep[6], ep[7])]
+        return [(ep[2], ep[3]), (ep[4], ep[5])]
+    return pad
+
+
+@op("Conv2D")
+def _conv2d(node, xs, ctx):
+    x, k = xs
+    x, nchw = _nhwc(node, x)
+    strides = list(node.attr["strides"].list.i)
+    dil = list(node.attr["dilations"].list.i) or [1, 1, 1, 1]
+    s = (strides[2], strides[3]) if node.attr["data_format"].s == b"NCHW" else (strides[1], strides[2])
+    d = (dil[2], dil[3]) if node.attr["data_format"].s == b"NCHW" else (dil[1], dil[2])
+    y = lax.conv_general_dilated(
+        x, k, window_strides=s, padding=_conv_padding(node),
+        rhs_dilation=d, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return _from_nhwc(y, nchw)
+
+
+@op("DepthwiseConv2dNative")
+def _depthwise(node, xs, ctx):
+    x, k = xs
+    x, nchw = _nhwc(node, x)
+    strides = list(node.attr["strides"].list.i)
+    s = (strides[1], strides[2])
+    # TF out-channel k is c*mult + m (c-major), which is exactly what a
+    # plain reshape of (kh,kw,cin,mult) gives for grouped-conv HWIO.
+    kh, kw, cin, mult = k.shape
+    k = jnp.reshape(k, (kh, kw, 1, cin * mult))
+    y = lax.conv_general_dilated(
+        x, k, window_strides=s, padding=_conv_padding(node),
+        feature_group_count=cin, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return _from_nhwc(y, nchw)
+
+
+@op("Conv2DBackpropInput")
+def _conv2d_transpose(node, xs, ctx):
+    out_shape, k, x = xs
+    out_shape = _require_static(out_shape, node, "output shape")
+    strides = list(node.attr["strides"].list.i)
+    pad = node.attr["padding"].s.decode()
+    y = lax.conv_transpose(
+        x, k, strides=(strides[1], strides[2]), padding=pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), transpose_kernel=True)
+    if tuple(y.shape) != tuple(out_shape):
+        raise UnsupportedOpError("Conv2DBackpropInput shape mismatch", node.name)
+    return y
+
+
+def _pool(node, xs, reducer, init):
+    x = xs[0]
+    x, nchw = _nhwc(node, x)
+    ks = list(node.attr["ksize"].list.i)
+    st = list(node.attr["strides"].list.i)
+    if (node.attr["data_format"].s or b"NHWC") == b"NCHW":
+        ks = [ks[0], ks[2], ks[3], ks[1]]
+        st = [st[0], st[2], st[3], st[1]]
+    pad = node.attr["padding"].s.decode()
+    y = lax.reduce_window(x, init, reducer, tuple(ks), tuple(st), pad)
+    return y, x, ks, st, pad, nchw
+
+
+@op("MaxPool")
+def _max_pool(node, xs, ctx):
+    y, _x, _k, _s, _p, nchw = _pool(node, xs, lax.max, -jnp.inf)
+    return _from_nhwc(y, nchw)
+
+
+@op("AvgPool")
+def _avg_pool(node, xs, ctx):
+    # TF AvgPool divides by the count of *in-bounds* elements under SAME
+    y, x, ks, st, pad, nchw = _pool(node, xs, lax.add, 0.0)
+    ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+    counts = lax.reduce_window(ones, 0.0, lax.add, tuple(ks), tuple(st), pad)
+    return _from_nhwc(y / counts, nchw)
+
+
+@op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(node, xs, ctx):
+    x, scale, offset, mean, var = xs[:5]
+    if node.attr["is_training"].b:
+        raise UnsupportedOpError("FusedBatchNorm(is_training=True)", node.name)
+    eps = node.attr["epsilon"].f or 1e-3
+    x, nchw = _nhwc(node, x)
+    y = (x - mean) * lax.rsqrt(var + eps) * scale + offset
+    y = _from_nhwc(y, nchw)
+    return (y, mean, var, mean, var, var)  # aux outputs per TF signature
+
+
+@op("Softmax")
+def _softmax(node, xs, ctx):
+    return jax.nn.softmax(xs[0], axis=-1)
+
+
+@op("LogSoftmax")
+def _log_softmax(node, xs, ctx):
+    return jax.nn.log_softmax(xs[0], axis=-1)
+
+
+@op("LeakyRelu")
+def _leaky_relu(node, xs, ctx):
+    alpha = node.attr["alpha"].f if "alpha" in node.attr else 0.2
+    return jax.nn.leaky_relu(xs[0], alpha)
+
+
+@op("Reshape")
+def _reshape(node, xs, ctx):
+    shape = _require_static(xs[1], node, "reshape target").astype(np.int64)
+    return jnp.reshape(xs[0], tuple(int(d) for d in shape))
+
+
+@op("Squeeze")
+def _squeeze(node, xs, ctx):
+    dims = list(node.attr["squeeze_dims"].list.i)
+    return jnp.squeeze(xs[0], axis=tuple(dims) if dims else None)
+
+
+@op("ExpandDims")
+def _expand_dims(node, xs, ctx):
+    axis = int(_require_static(xs[1], node, "axis"))
+    return jnp.expand_dims(xs[0], axis)
+
+
+@op("Transpose")
+def _transpose(node, xs, ctx):
+    perm = _require_static(xs[1], node, "perm")
+    return jnp.transpose(xs[0], tuple(int(p) for p in perm))
+
+
+@op("ConcatV2")
+def _concat(node, xs, ctx):
+    axis = int(_require_static(xs[-1], node, "axis"))
+    return jnp.concatenate(xs[:-1], axis=axis)
+
+
+@op("Concat")
+def _concat_v1(node, xs, ctx):
+    axis = int(_require_static(xs[0], node, "axis"))
+    return jnp.concatenate(xs[1:], axis=axis)
+
+
+@op("Pack")
+def _pack(node, xs, ctx):
+    return jnp.stack(xs, axis=node.attr["axis"].i)
+
+
+@op("Unpack")
+def _unpack(node, xs, ctx):
+    axis = node.attr["axis"].i
+    n = node.attr["num"].i
+    parts = jnp.split(xs[0], n, axis=axis)
+    return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+
+@op("Split")
+def _split(node, xs, ctx):
+    axis = int(_require_static(xs[0], node, "axis"))
+    return tuple(jnp.split(xs[1], node.attr["num_split"].i, axis=axis))
+
+
+@op("SplitV")
+def _splitv(node, xs, ctx):
+    sizes = _require_static(xs[1], node, "split sizes")
+    axis = int(_require_static(xs[2], node, "axis"))
+    idx = np.cumsum(sizes)[:-1]
+    return tuple(jnp.split(xs[0], [int(i) for i in idx], axis=axis))
+
+
+@op("Slice")
+def _slice(node, xs, ctx):
+    begin = _require_static(xs[1], node, "begin")
+    size = _require_static(xs[2], node, "size")
+    x = xs[0]
+    lims = [b + (s if s != -1 else x.shape[i] - b)
+            for i, (b, s) in enumerate(zip(begin, size))]
+    return lax.slice(x, [int(b) for b in begin], [int(l) for l in lims])
+
+
+@op("StridedSlice")
+def _strided_slice(node, xs, ctx):
+    x, begin, end, strides = xs
+    begin = _require_static(begin, node, "begin")
+    end = _require_static(end, node, "end")
+    strides = _require_static(strides, node, "strides")
+    bm = node.attr["begin_mask"].i
+    em = node.attr["end_mask"].i
+    ell = node.attr["ellipsis_mask"].i
+    na = node.attr["new_axis_mask"].i
+    sa = node.attr["shrink_axis_mask"].i
+    idx = []
+    for i in range(len(begin)):
+        if ell & (1 << i):
+            idx.append(Ellipsis)
+        elif na & (1 << i):
+            idx.append(None)
+        elif sa & (1 << i):
+            idx.append(int(begin[i]))
+        else:
+            b = None if bm & (1 << i) else int(begin[i])
+            e = None if em & (1 << i) else int(end[i])
+            idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+@op("Shape")
+def _shape(node, xs, ctx):
+    # Under jit, shapes are static → emit a constant, keeping XLA happy.
+    dt = _np_dtype(node.attr["out_type"].type) if node.attr["out_type"].type else np.int32
+    return np.asarray(xs[0].shape, dtype=dt)
+
+
+@op("Size")
+def _size(node, xs, ctx):
+    return np.asarray(int(np.prod(xs[0].shape)), dtype=np.int32)
+
+
+@op("Rank")
+def _rank(node, xs, ctx):
+    return np.asarray(xs[0].ndim, dtype=np.int32)
+
+
+@op("Fill")
+def _fill(node, xs, ctx):
+    dims = _require_static(xs[0], node, "fill dims")
+    return jnp.full(tuple(int(d) for d in dims), xs[1])
+
+
+@op("Range")
+def _range(node, xs, ctx):
+    s, l, d = (_require_static(v, node, "range operand") for v in xs)
+    return jnp.arange(s.item(), l.item(), d.item())
+
+
+@op("Tile")
+def _tile(node, xs, ctx):
+    reps = _require_static(xs[1], node, "multiples")
+    return jnp.tile(xs[0], tuple(int(r) for r in reps))
+
+
+@op("Pad", "PadV2", "MirrorPad")
+def _pad(node, xs, ctx):
+    pads = _require_static(xs[1], node, "paddings")
+    cfg = [(int(a), int(b)) for a, b in pads]
+    if node.op == "MirrorPad":
+        mode = node.attr["mode"].s.decode().lower()
+        mode = {"symmetric": "symmetric", "reflect": "reflect"}[mode]
+        return jnp.pad(xs[0], cfg, mode=mode)
+    cval = xs[2] if len(xs) > 2 else 0
+    return jnp.pad(xs[0], cfg, constant_values=cval)
+
+
+def _reduction(fn):
+    def handler(node, xs, ctx):
+        axes = _static_or_np(xs[1])
+        keep = node.attr["keep_dims"].b
+        ax = tuple(int(a) for a in np.atleast_1d(axes)) if axes is not None else None
+        if ax is None:
+            raise UnsupportedOpError("dynamic reduction axes", node.name)
+        return fn(xs[0], axis=ax, keepdims=keep)
+    return handler
+
+
+_OPS["Mean"] = _reduction(jnp.mean)
+_OPS["Sum"] = _reduction(jnp.sum)
+_OPS["Max"] = _reduction(jnp.max)
+_OPS["Min"] = _reduction(jnp.min)
+_OPS["Prod"] = _reduction(jnp.prod)
+_OPS["All"] = _reduction(jnp.all)
+_OPS["Any"] = _reduction(jnp.any)
+
+
+@op("ArgMax")
+def _argmax(node, xs, ctx):
+    axis = int(_require_static(xs[1], node, "axis"))
+    dt = _np_dtype(node.attr["output_type"].type) if node.attr["output_type"].type else np.int64
+    return jnp.argmax(xs[0], axis=axis).astype(dt)
+
+
+@op("ArgMin")
+def _argmin(node, xs, ctx):
+    axis = int(_require_static(xs[1], node, "axis"))
+    return jnp.argmin(xs[0], axis=axis)
+
+
+@op("Select", "SelectV2")
+def _select(node, xs, ctx):
+    return jnp.where(xs[0], xs[1], xs[2])
+
+
+@op("GatherV2")
+def _gather(node, xs, ctx):
+    axis = int(_require_static(xs[2], node, "axis"))
+    return jnp.take(xs[0], xs[1], axis=axis)
+
+
+@op("Gather")
+def _gather_v1(node, xs, ctx):
+    return jnp.take(xs[0], xs[1], axis=0)
+
+
+@op("TopKV2")
+def _topk(node, xs, ctx):
+    k = int(_require_static(xs[1], node, "k"))
+    vals, idxs = lax.top_k(xs[0], k)
+    return vals, idxs.astype(np.int32)
+
+
+@op("ResizeBilinear")
+def _resize_bilinear(node, xs, ctx):
+    size = _require_static(xs[1], node, "size")
+    x = xs[0]
+    out = (x.shape[0], int(size[0]), int(size[1]), x.shape[3])
+    if node.attr["half_pixel_centers"].b:
+        method = "bilinear"  # jax.image 'bilinear' uses half-pixel centers
+        return jax.image.resize(x, out, method=method).astype(x.dtype)
+    raise UnsupportedOpError("ResizeBilinear(align_corners legacy)", node.name)
+
+
+@op("ResizeNearestNeighbor")
+def _resize_nearest(node, xs, ctx):
+    size = _require_static(xs[1], node, "size")
+    x = xs[0]
+    out = (x.shape[0], int(size[0]), int(size[1]), x.shape[3])
+    return jax.image.resize(x, out, method="nearest")
+
+
+@op("L2Loss")
+def _l2loss(node, xs, ctx):
+    return jnp.sum(jnp.square(xs[0])) / 2
+
+
+@op("Cumsum")
+def _cumsum(node, xs, ctx):
+    axis = int(_require_static(xs[1], node, "axis"))
+    return jnp.cumsum(xs[0], axis=axis)
+
+
+@op("DecodeRaw")
+def _decode_raw(node, xs, ctx):
+    # image-struct bytes → tensor (ref: graph/pieces.py buildSpImageConverter
+    # uses tf.decode_raw). Host-side only: bytes must be concrete.
+    raw = _require_static(xs[0], node, "raw bytes")
+    dt = _np_dtype(node.attr["out_type"].type)
+    # DT_STRING consts arrive as object arrays holding bytes; .tobytes()
+    # on those would serialize PyObject pointers, so take the element.
+    payload = raw.item() if raw.dtype == object or raw.shape == () else raw.tobytes()
+    if not isinstance(payload, bytes):
+        payload = bytes(payload)
+    return np.frombuffer(payload, dtype=dt)
+
+
+# ---------------------------------------------------------------------------
+# numpy fast paths for shape math
+#
+# Under jit, every jnp op stages into the trace (omnistaging) — even on
+# constant operands. Shape-computation subgraphs (Flatten's
+# Shape→StridedSlice→Pack→Reshape chain, etc.) must stay *concrete* so the
+# downstream Reshape sees a static target. These handlers evaluate in
+# numpy whenever every input is already concrete.
+# ---------------------------------------------------------------------------
+def _np_cast(node, xs):
+    return np.asarray(xs[0]).astype(_np_dtype(node.attr["DstT"].type))
+
+
+_NP_FAST = {
+    "Pack": lambda node, xs: np.stack(xs, axis=node.attr["axis"].i),
+    "Unpack": lambda node, xs: tuple(
+        np.squeeze(p, axis=node.attr["axis"].i)
+        for p in np.split(xs[0], node.attr["num"].i, axis=node.attr["axis"].i)),
+    "ConcatV2": lambda node, xs: np.concatenate(xs[:-1], axis=int(xs[-1])),
+    "Cast": _np_cast,
+    "Add": lambda node, xs: np.add(*xs), "AddV2": lambda node, xs: np.add(*xs),
+    "Sub": lambda node, xs: np.subtract(*xs),
+    "Mul": lambda node, xs: np.multiply(*xs),
+    "RealDiv": lambda node, xs: np.divide(*xs),
+    "FloorDiv": lambda node, xs: np.floor_divide(*xs),
+    "FloorMod": lambda node, xs: np.mod(*xs),
+    "Maximum": lambda node, xs: np.maximum(*xs),
+    "Minimum": lambda node, xs: np.minimum(*xs),
+    "Neg": lambda node, xs: np.negative(xs[0]),
+    "Equal": lambda node, xs: np.equal(*xs),
+    "Greater": lambda node, xs: np.greater(*xs),
+    "Less": lambda node, xs: np.less(*xs),
+    "Squeeze": lambda node, xs: np.squeeze(
+        xs[0], axis=tuple(node.attr["squeeze_dims"].list.i) or None),
+    "ExpandDims": lambda node, xs: np.expand_dims(xs[0], int(xs[1])),
+    "Reshape": lambda node, xs: np.reshape(
+        xs[0], tuple(int(d) for d in np.asarray(xs[1]))),
+    "Transpose": lambda node, xs: np.transpose(
+        xs[0], tuple(int(p) for p in xs[1])),
+    "GatherV2": lambda node, xs: np.take(xs[0], xs[1], axis=int(xs[2])),
+    "Range": lambda node, xs: np.arange(xs[0].item(), xs[1].item(), xs[2].item()),
+    "Fill": lambda node, xs: np.full(tuple(int(d) for d in xs[0]), xs[1]),
+    "Prod": lambda node, xs: np.prod(
+        xs[0], axis=tuple(int(a) for a in np.atleast_1d(xs[1])),
+        keepdims=node.attr["keep_dims"].b),
+    "Sum": lambda node, xs: np.sum(
+        xs[0], axis=tuple(int(a) for a in np.atleast_1d(xs[1])),
+        keepdims=node.attr["keep_dims"].b),
+    "Tile": lambda node, xs: np.tile(xs[0], tuple(int(r) for r in xs[1])),
+    "Select": lambda node, xs: np.where(*xs),
+    "SelectV2": lambda node, xs: np.where(*xs),
+}
+
+
+def _all_static(xs):
+    return all(isinstance(x, (np.ndarray, np.generic, int, float, bytes))
+               for x in xs)
+
+
+# ---------------------------------------------------------------------------
+# graph evaluation
+# ---------------------------------------------------------------------------
+class _GraphEval:
+    """One GraphDef (plus its function library) evaluated lazily into an
+    env of tensor values. Iterative DFS — no Python recursion limit on
+    1000+-node chains (InceptionV3-scale)."""
+
+    def __init__(self, nodes, library):
+        self.nodes = {n.name: n for n in nodes}
+        self.library = library  # name -> FunctionDef
+
+    def run(self, env: dict, fetches: list[str]):
+        for f in fetches:
+            self._eval(env, f)
+        return [env[tensor_name(f)] for f in fetches]
+
+    def _eval(self, env, fetch):
+        stack = [op_name(fetch)]
+        while stack:
+            name = stack[-1]
+            if tensor_name(name) in env or (name + ":0") in env:
+                stack.pop()
+                continue
+            node = self.nodes.get(name)
+            if node is None:
+                raise KeyError(f"GraphDef has no node {name!r}")
+            deps = [i for i in node.input if not i.startswith("^")]
+            missing = [d for d in deps if tensor_name(d) not in env]
+            if missing:
+                stack.extend(op_name(d) for d in missing)
+                continue
+            stack.pop()
+            self._apply(env, node, [env[tensor_name(d)] for d in deps])
+
+    def _apply(self, env, node, xs):
+        if node.op in ("PartitionedCall", "StatefulPartitionedCall"):
+            out = self._call_function(node.attr["f"].func.name, xs)
+        elif node.op == "IdentityN":
+            out = tuple(xs)
+        elif node.op == "Placeholder" or node.op == "PlaceholderWithDefault":
+            if node.op == "PlaceholderWithDefault" and tensor_name(node.name) not in env:
+                out = xs[0]
+            else:
+                raise KeyError(
+                    f"placeholder {node.name!r} was not fed (feeds are bound "
+                    "before evaluation; is it missing from the input map?)")
+        elif node.op in _NP_FAST and xs and _all_static(xs):
+            out = _NP_FAST[node.op](node, xs)
+        else:
+            handler = _OPS.get(node.op)
+            if handler is None:
+                raise UnsupportedOpError(node.op, node.name)
+            out = handler(node, xs, self)
+        if isinstance(out, tuple):
+            for i, v in enumerate(out):
+                env[f"{node.name}:{i}"] = v
+        else:
+            env[tensor_name(node.name)] = out
+
+    def _call_function(self, fname, xs):
+        fdef = self.library[fname]
+        sub = _GraphEval(fdef.node_def, self.library)
+        env = {}
+        for arg, val in zip(fdef.signature.input_arg, xs):
+            env[f"{arg.name}:0"] = val
+        outs = []
+        for out_arg in fdef.signature.output_arg:
+            ret = fdef.ret[out_arg.name]  # e.g. "Identity:output:0"
+            parts = ret.split(":")
+            src = f"{parts[0]}:{parts[-1]}" if len(parts) == 3 else tensor_name(ret)
+            sub._eval(env, parts[0])
+            outs.append(env[src])
+        return tuple(outs) if len(outs) != 1 else outs[0]
+
+
+def build_jax_fn(graph_def, feeds, fetches, *, capture_map=None):
+    """Translate ``graph_def`` into a pure jax-traceable callable.
+
+    feeds/fetches: tensor names ("x" or "x:0"). Returns
+    ``fn(*feed_values) -> tuple`` — or, when ``capture_map``
+    ({placeholder node name → params-pytree key}) is given,
+    ``fn(params, *feed_values) -> tuple`` with every mapped placeholder
+    bound from ``params`` (the trainable route; jax.grad flows through).
+
+    The translation is lazy per call, so jit tracing visits exactly the
+    subgraph reachable from ``fetches`` — the moral equivalent of the
+    reference's ``strip_and_freeze_until`` pruning
+    (ref: sparkdl graph/utils.py ~L200), done by tracing instead of proto
+    surgery.
+    """
+    feeds = [tensor_name(f) for f in feeds]
+    fetches = [tensor_name(f) for f in fetches]
+    ev = _GraphEval(graph_def.node, {f.signature.name: f
+                                     for f in graph_def.library.function})
+
+    if capture_map is None:
+        def fn(*args):
+            if len(args) != len(feeds):
+                raise TypeError(f"expected {len(feeds)} inputs {feeds}, got {len(args)}")
+            env = dict(zip(feeds, (jnp.asarray(a) for a in args)))
+            out = ev.run(env, fetches)
+            return tuple(out) if len(out) != 1 else out[0]
+    else:
+        def fn(params, *args):
+            if len(args) != len(feeds):
+                raise TypeError(f"expected {len(feeds)} inputs {feeds}, got {len(args)}")
+            env = dict(zip(feeds, (jnp.asarray(a) for a in args)))
+            for ph, key in capture_map.items():
+                env[tensor_name(ph)] = params[key]
+            out = ev.run(env, fetches)
+            return tuple(out) if len(out) != 1 else out[0]
+
+    fn.input_names = feeds
+    fn.output_names = fetches
+    return fn

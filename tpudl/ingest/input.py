@@ -1,0 +1,283 @@
+"""TFInputGraph — the universal model-ingestion factory matrix.
+
+Name-for-name rebuild of the reference's ingester
+(ref: python/sparkdl/graph/input.py — class TFInputGraph ~L40; factories
+fromGraph/fromGraphDef/fromSavedModel/fromSavedModelWithSignature/
+fromCheckpoint/fromCheckpointWithSignature ~L80-350). Same six
+construction routes, same "freeze variables then hand off" semantics —
+but the handoff target is the GraphDef→JAX translator
+(:mod:`tpudl.ingest.graphdef`) producing one jittable XLA program,
+instead of a GraphDef shipped to executor TF sessions.
+
+TF (2.x compat APIs) is used strictly as the *loader* for TF1-era
+artifacts — graphs, SavedModels, Saver checkpoints — per SURVEY.md §7.0.
+Two TPU-native additions beyond the reference's matrix:
+
+- ``fromKeras`` — Keras model/file → frozen jax fn (the reference routed
+  this through graph/builder.py Keras freezing instead).
+- ``fromKerasTrainable`` — Keras model → (fn(params, x), params pytree),
+  differentiable end-to-end; the frozen-protobuf reference could only
+  ever run inference on ingested models.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from tpudl.ingest.graphdef import build_jax_fn, op_name, tensor_name
+
+__all__ = ["TFInputGraph"]
+
+
+def _tf():
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import tensorflow as tf
+
+    return tf
+
+
+class TFInputGraph:
+    """An ingested, frozen model graph plus its input/output tensor names.
+
+    Attributes mirror the reference (graph/input.py ~L40):
+
+    - ``graph_def``: frozen ``tf.GraphDef`` proto (variables → consts).
+    - ``input_tensor_name_from_signature`` / ``output_tensor_name_from_signature``:
+      {logical signature name → tensor name} when built from a signature,
+      else None.
+    - ``input_names`` / ``output_names``: the concrete feed/fetch tensor
+      names of the ingested slice.
+
+    ``make_fn()`` yields the jax-traceable callable; everything downstream
+    (TFTransformer, TFImageTransformer, UDFs) runs that under ``jit``.
+    """
+
+    def __init__(self, graph_def, input_names, output_names,
+                 input_sig=None, output_sig=None, params=None,
+                 capture_map=None):
+        self.graph_def = graph_def
+        self.input_names = [tensor_name(n) for n in input_names]
+        self.output_names = [tensor_name(n) for n in output_names]
+        self.input_tensor_name_from_signature = input_sig
+        self.output_tensor_name_from_signature = output_sig
+        self.params = params  # non-None only for the trainable route
+        self._capture_map = capture_map
+
+    # -- execution handoff -------------------------------------------------
+    def make_fn(self, feeds=None, fetches=None):
+        """Build ``fn(*feeds) -> fetches`` (or ``fn(params, *feeds)`` for
+        trainable graphs); pure, jax-traceable, jit at the call site."""
+        return build_jax_fn(
+            self.graph_def,
+            feeds or self.input_names,
+            fetches or self.output_names,
+            capture_map=self._capture_map,
+        )
+
+    @property
+    def trainable(self) -> bool:
+        return self.params is not None
+
+    def __repr__(self):
+        return (f"TFInputGraph(inputs={self.input_names}, "
+                f"outputs={self.output_names}, trainable={self.trainable})")
+
+    # -- factory matrix (ref routes, same names) ---------------------------
+    @classmethod
+    def fromGraph(cls, graph, sess, feed_names, fetch_names):
+        """TF1-style live graph + session (ref: ~L80)."""
+        tf = _tf()
+        gdef = _freeze_v1(tf, sess, graph.as_graph_def(add_shapes=True),
+                          fetch_names)
+        return cls(gdef, feed_names, fetch_names)
+
+    @classmethod
+    def fromGraphDef(cls, graph_def, feed_names, fetch_names):
+        """Already-frozen GraphDef proto (ref: ~L110)."""
+        return cls(graph_def, feed_names, fetch_names)
+
+    @classmethod
+    def fromSavedModel(cls, saved_model_dir, tag_set, feed_names, fetch_names):
+        """SavedModel with explicit feeds/fetches (ref: ~L150)."""
+        gdef, _meta = _load_saved_model_frozen(saved_model_dir, tag_set,
+                                               fetch_names)
+        return cls(gdef, feed_names, fetch_names)
+
+    @classmethod
+    def fromSavedModelWithSignature(cls, saved_model_dir, tag_set,
+                                    signature_def_key):
+        """SavedModel; feeds/fetches resolved from its SignatureDef
+        (ref: ~L180)."""
+        tf = _tf()
+        with tf.Graph().as_default() as g, tf.compat.v1.Session(graph=g) as sess:
+            meta = tf.compat.v1.saved_model.loader.load(
+                sess, _tags(tag_set), saved_model_dir)
+            in_sig, out_sig = _signature_maps(meta, signature_def_key)
+            fetch_names = list(out_sig.values())
+            gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
+                              fetch_names)
+        return cls(gdef, list(in_sig.values()), fetch_names,
+                   input_sig=in_sig, output_sig=out_sig)
+
+    @classmethod
+    def fromCheckpoint(cls, checkpoint_dir, feed_names, fetch_names):
+        """TF1 Saver checkpoint directory (ref: ~L250)."""
+        gdef, _meta = _load_checkpoint_frozen(checkpoint_dir, fetch_names)
+        return cls(gdef, feed_names, fetch_names)
+
+    @classmethod
+    def fromCheckpointWithSignature(cls, checkpoint_dir, signature_def_key):
+        """Checkpoint; feeds/fetches from the MetaGraph's SignatureDef
+        (ref: ~L300)."""
+        tf = _tf()
+        ckpt = tf.train.latest_checkpoint(checkpoint_dir)
+        if ckpt is None:
+            raise ValueError(f"no checkpoint found under {checkpoint_dir!r}")
+        from google.protobuf import message
+
+        meta = tf.compat.v1.MetaGraphDef()
+        with open(ckpt + ".meta", "rb") as f:
+            try:
+                meta.ParseFromString(f.read())
+            except message.DecodeError as e:
+                raise ValueError(f"corrupt meta graph {ckpt}.meta") from e
+        with tf.Graph().as_default() as g, tf.compat.v1.Session(graph=g) as sess:
+            saver = tf.compat.v1.train.import_meta_graph(meta)
+            saver.restore(sess, ckpt)
+            in_sig, out_sig = _signature_maps(meta, signature_def_key)
+            fetch_names = list(out_sig.values())
+            gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
+                              fetch_names)
+        return cls(gdef, list(in_sig.values()), fetch_names,
+                   input_sig=in_sig, output_sig=out_sig)
+
+    # -- TPU-native additions ----------------------------------------------
+    @classmethod
+    def fromKeras(cls, model_or_path):
+        """Keras model instance or .keras/.h5 path → frozen inference graph
+        (replaces ref graph/builder.py GraphFunction-from-Keras route)."""
+        tf = _tf()
+        model = _load_keras(model_or_path)
+        cf = _concrete_fn(tf, model)
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2)
+
+        frozen = convert_variables_to_constants_v2(cf)
+        gdef = frozen.graph.as_graph_def(add_shapes=True)
+        return cls(gdef, [t.name for t in frozen.inputs],
+                   [t.name for t in frozen.outputs])
+
+    @classmethod
+    def fromKerasTrainable(cls, model_or_path):
+        """Keras model → trainable ingestion: variables stay symbolic,
+        surfaced as a params pytree keyed by variable name; the built fn is
+        ``fn(params, x)`` and differentiates with jax.grad."""
+        tf = _tf()
+        model = _load_keras(model_or_path)
+        cf = _concrete_fn(tf, model)
+        gdef = cf.graph.as_graph_def(add_shapes=True)
+        capture_map, params = {}, {}
+        for ext, internal in cf.graph.captures:
+            vs = [v for v in cf.variables if v.handle is ext]
+            if not vs:
+                raise ValueError(
+                    f"capture {internal.name!r} is not a model variable; "
+                    "non-variable captures are not ingestable as params")
+            key = vs[0].name.split(":")[0]
+            capture_map[op_name(internal.name)] = key
+            params[key] = np.asarray(vs[0])
+        n_caps = len(capture_map)
+        inputs = [t.name for t in cf.inputs[: len(cf.inputs) - n_caps]]
+        outputs = [t.name for t in cf.outputs]
+        return cls(gdef, inputs, outputs, params=params,
+                   capture_map=capture_map)
+
+
+# -- loader plumbing -------------------------------------------------------
+def _tags(tag_set):
+    if isinstance(tag_set, str):
+        return tag_set.split(",")
+    return list(tag_set)
+
+
+def _freeze_v1(tf, sess, graph_def, fetch_names):
+    """variables → consts, pruned to fetches (ref: graph/utils.py
+    strip_and_freeze_until ~L200)."""
+    out_ops = sorted({op_name(f) for f in fetch_names})
+    with _suppress_deprecation():
+        return tf.compat.v1.graph_util.convert_variables_to_constants(
+            sess, graph_def, out_ops)
+
+
+def _suppress_deprecation():
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        import tensorflow as tf
+
+        prev = tf.compat.v1.logging.get_verbosity()
+        tf.compat.v1.logging.set_verbosity(tf.compat.v1.logging.ERROR)
+        try:
+            yield
+        finally:
+            tf.compat.v1.logging.set_verbosity(prev)
+
+    return ctx()
+
+
+def _signature_maps(meta_graph, signature_def_key):
+    sig = meta_graph.signature_def.get(signature_def_key)
+    if sig is None:
+        raise KeyError(
+            f"SignatureDef {signature_def_key!r} not found; available: "
+            f"{sorted(meta_graph.signature_def)}")
+    in_sig = {k: v.name for k, v in sig.inputs.items()}
+    out_sig = {k: v.name for k, v in sig.outputs.items()}
+    return in_sig, out_sig
+
+
+def _load_saved_model_frozen(saved_model_dir, tag_set, fetch_names):
+    tf = _tf()
+    with tf.Graph().as_default() as g, tf.compat.v1.Session(graph=g) as sess:
+        meta = tf.compat.v1.saved_model.loader.load(
+            sess, _tags(tag_set), saved_model_dir)
+        gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
+                          fetch_names)
+    return gdef, meta
+
+
+def _load_checkpoint_frozen(checkpoint_dir, fetch_names):
+    tf = _tf()
+    ckpt = tf.train.latest_checkpoint(checkpoint_dir)
+    if ckpt is None:
+        raise ValueError(f"no checkpoint found under {checkpoint_dir!r}")
+    with tf.Graph().as_default() as g, tf.compat.v1.Session(graph=g) as sess:
+        saver = tf.compat.v1.train.import_meta_graph(ckpt + ".meta")
+        saver.restore(sess, ckpt)
+        gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
+                          fetch_names)
+    return gdef, None
+
+
+def _load_keras(model_or_path):
+    from tpudl.zoo.convert import load_keras_model
+
+    return load_keras_model(model_or_path)
+
+
+def _concrete_fn(tf, model):
+    specs = [tf.TensorSpec([None, *i.shape[1:]], i.dtype) for i in model.inputs]
+    if len(specs) != 1:
+        raise ValueError(
+            f"only single-input Keras models are ingestable (got "
+            f"{len(specs)} inputs)")
+
+    @tf.function(autograph=False)
+    def f(x):
+        return model(x)
+
+    return f.get_concrete_function(specs[0])
