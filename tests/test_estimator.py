@@ -1,0 +1,216 @@
+"""KerasImageFileEstimator tests — the rebuild of the reference's
+python/tests/estimators/test_keras_estimators.py (SURVEY.md §4): tiny
+CNN, a few param maps, fit/fitMultiple over fixture images, returned
+transformers actually transform; plus the SQL-UDF registration suite
+(python/tests/udf/keras_image_model_test.py pattern).
+"""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+from PIL import Image  # noqa: E402
+
+from tpudl.frame import Frame  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def image_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("imgs")
+    rng = np.random.default_rng(0)
+    uris, labels = [], []
+    for i in range(12):
+        arr = rng.integers(0, 255, size=(16, 16, 3), dtype=np.uint8)
+        # class 0: dark top half; class 1: dark bottom half — learnable
+        cls = i % 2
+        if cls == 0:
+            arr[:8] //= 4
+        else:
+            arr[8:] //= 4
+        p = str(d / f"im{i}.png")
+        Image.fromarray(arr).save(p)
+        uris.append(p)
+        labels.append(np.eye(2, dtype=np.float32)[cls])
+    return uris, labels
+
+
+def _loader(uri):
+    img = Image.open(uri).convert("RGB").resize((12, 12), Image.BILINEAR)
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+@pytest.fixture(scope="module")
+def tiny_model_file(tmp_path_factory):
+    keras.utils.set_random_seed(0)
+    m = keras.Sequential([
+        keras.layers.Input((12, 12, 3)),
+        keras.layers.Conv2D(4, 3, activation="relu"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    path = str(tmp_path_factory.mktemp("model") / "tiny.keras")
+    m.save(path)
+    return path
+
+
+def _estimator(tiny_model_file, **fit_params):
+    from tpudl.ml import KerasImageFileEstimator
+
+    return KerasImageFileEstimator(
+        inputCol="uri", outputCol="pred", labelCol="label",
+        imageLoader=_loader, modelFile=tiny_model_file,
+        kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
+        kerasFitParams={"batch_size": 4, "epochs": 4, **fit_params})
+
+
+def _frame(image_files):
+    uris, labels = image_files
+    return Frame({"uri": np.array(uris, dtype=object),
+                  "label": np.array(labels, dtype=object)})
+
+
+class TestEstimator:
+    def test_fit_returns_working_transformer(self, image_files,
+                                             tiny_model_file):
+        est = _estimator(tiny_model_file)
+        frame = _frame(image_files)
+        model = est.fit(frame)
+        out = model.transform(frame)
+        preds = np.stack(list(out["pred"]))
+        assert preds.shape == (12, 2)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_training_reduces_loss(self, image_files, tiny_model_file):
+        est = _estimator(tiny_model_file)
+        frame = _frame(image_files)
+        X, y = est._getNumpyFeaturesAndLabels(frame)
+        _model, gin, _keys = est._ingest()
+        params, losses = est._train_one(gin, X, y)
+        assert losses[-1] < losses[0], f"loss did not fall: {losses}"
+
+    def test_fit_multiple_yields_all_models(self, image_files,
+                                            tiny_model_file):
+        est = _estimator(tiny_model_file)
+        frame = _frame(image_files)
+        pms = [
+            {est.kerasFitParams: {"batch_size": 4, "epochs": 1}},
+            {est.kerasFitParams: {"batch_size": 4, "epochs": 2,
+                                  "learning_rate": 1e-2}},
+        ]
+        got = dict(est.fitMultiple(frame, pms))
+        assert sorted(got) == [0, 1]
+        for m in got.values():
+            preds = np.stack(list(m.transform(frame)["pred"]))
+            assert preds.shape == (12, 2)
+
+    def test_fit_with_param_list_via_base(self, image_files,
+                                          tiny_model_file):
+        est = _estimator(tiny_model_file)
+        frame = _frame(image_files)
+        models = est.fit(frame, [
+            {est.kerasFitParams: {"batch_size": 4, "epochs": 1}},
+            {est.kerasFitParams: {"batch_size": 6, "epochs": 1}},
+        ])
+        assert len(models) == 2
+
+    def test_bad_fit_param_rejected(self, image_files, tiny_model_file):
+        est = _estimator(tiny_model_file, nonsense=True)
+        frame = _frame(image_files)
+        with pytest.raises(ValueError, match="nonsense"):
+            est.fit(frame)
+
+    def test_fit_multiple_honors_model_file_override(self, image_files,
+                                                     tiny_model_file,
+                                                     tmp_path):
+        # regression: overrides of shared params must not be ignored
+        keras.utils.set_random_seed(1)
+        other = keras.Sequential([
+            keras.layers.Input((12, 12, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        other_path = str(tmp_path / "other.keras")
+        other.save(other_path)
+        est = _estimator(tiny_model_file)
+        frame = _frame(image_files)
+        got = dict(est.fitMultiple(frame, [{est.modelFile: other_path}]))
+        # the trained artifact must have the override's architecture
+        trained = keras.saving.load_model(got[0].getModelFile(),
+                                          compile=False)
+        layer_types = {type(l).__name__ for l in trained.layers}
+        assert "Conv2D" not in layer_types and "Flatten" in layer_types
+
+    def test_empty_frame_clear_error(self, tiny_model_file):
+        est = _estimator(tiny_model_file)
+        empty = Frame({"uri": np.array([], dtype=object),
+                       "label": np.array([], dtype=object)})
+        with pytest.raises(ValueError, match="empty"):
+            est.fit(empty)
+
+    def test_bad_optimizer_name_rejected(self, tiny_model_file):
+        from tpudl.ml import KerasImageFileEstimator
+
+        with pytest.raises(TypeError, match="optimizer"):
+            KerasImageFileEstimator(
+                inputCol="uri", outputCol="p", labelCol="l",
+                imageLoader=_loader, modelFile=tiny_model_file,
+                kerasOptimizer="madgrad", kerasLoss="mse")
+
+
+class TestKerasImageUDF:
+    def test_register_and_sql(self, tmp_path):
+        from tpudl import sql
+        from tpudl.image import imageIO
+        from tpudl.udf.keras_image_model import registerKerasImageUDF
+        from tpudl.udf import registry
+
+        keras.utils.set_random_seed(0)
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        rng = np.random.default_rng(0)
+        structs = [imageIO.imageArrayToStruct(
+            rng.integers(0, 255, size=(10, 10, 3), dtype=np.uint8))
+            for _ in range(4)]
+        frame = Frame({"image": structs})
+        try:
+            registerKerasImageUDF("tiny_udf", m)
+            out = sql("SELECT tiny_udf(image) AS preds FROM t", {"t": frame})
+            got = np.stack(list(out["preds"]))
+            # oracle: BGR→RGB float then model
+            X = np.stack([imageIO.imageStructToArray(s)[:, :, ::-1]
+                          for s in structs]).astype(np.float32)
+            want = m.predict(X, verbose=0)
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        finally:
+            registry.unregister_udf("tiny_udf")
+
+    def test_preprocessor_composes(self):
+        from tpudl.image import imageIO
+        from tpudl.udf.keras_image_model import registerKerasImageUDF
+        from tpudl.udf import registry
+
+        keras.utils.set_random_seed(0)
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2),
+        ])
+        rng = np.random.default_rng(1)
+        structs = [imageIO.imageArrayToStruct(
+            rng.integers(0, 255, size=(10, 10, 3), dtype=np.uint8))
+            for _ in range(3)]
+        frame = Frame({"image": structs})
+        try:
+            udf = registerKerasImageUDF("pre_udf", m,
+                                        preprocessor=lambda x: x / 255.0)
+            out = udf(frame)
+            X = np.stack([imageIO.imageStructToArray(s)[:, :, ::-1]
+                          for s in structs]).astype(np.float32) / 255.0
+            want = m.predict(X, verbose=0)
+            np.testing.assert_allclose(np.stack(list(out["pre_udf_out"])),
+                                       want, rtol=1e-4, atol=1e-5)
+        finally:
+            registry.unregister_udf("pre_udf")

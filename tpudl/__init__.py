@@ -18,6 +18,18 @@ _LAZY = {
     "Frame": "tpudl.frame",
     "sql": "tpudl.frame",
     "register_udf": "tpudl.udf",
+    # L5 product surface (ref: sparkdl/__init__.py __all__)
+    "DeepImageFeaturizer": "tpudl.ml",
+    "DeepImagePredictor": "tpudl.ml",
+    "TFImageTransformer": "tpudl.ml",
+    "TFTransformer": "tpudl.ml",
+    "KerasTransformer": "tpudl.ml",
+    "KerasImageFileTransformer": "tpudl.ml",
+    "Pipeline": "tpudl.ml",
+    "PipelineModel": "tpudl.ml",
+    "TFInputGraph": "tpudl.ingest",
+    "KerasImageFileEstimator": "tpudl.ml.estimator",
+    "registerKerasImageUDF": "tpudl.udf.keras_image_model",
 }
 
 __all__ = ["__version__", *_LAZY]

@@ -1,0 +1,34 @@
+"""ML Pipeline API layer — the product surface (SURVEY.md §2.1 L5).
+
+Same public spellings as the reference's ``sparkdl`` package so a
+spark-deep-learning user finds every Transformer/Estimator under the
+name they know, running as fused XLA programs over the mesh.
+"""
+
+from tpudl.ml.estimator import KerasImageFileEstimator
+from tpudl.ml.keras_image import KerasImageFileTransformer
+from tpudl.ml.keras_tensor import KerasTransformer
+from tpudl.ml.named_image import DeepImageFeaturizer, DeepImagePredictor
+from tpudl.ml.params import Param, Params, TypeConverters
+from tpudl.ml.pipeline import (Estimator, Model, Pipeline, PipelineModel,
+                               Transformer)
+from tpudl.ml.tf_image import TFImageTransformer
+from tpudl.ml.tf_tensor import TFTransformer
+
+__all__ = [
+    "DeepImageFeaturizer",
+    "DeepImagePredictor",
+    "TFImageTransformer",
+    "TFTransformer",
+    "KerasTransformer",
+    "KerasImageFileTransformer",
+    "KerasImageFileEstimator",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "Param",
+    "Params",
+    "TypeConverters",
+]

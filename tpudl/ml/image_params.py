@@ -1,0 +1,61 @@
+"""Image-specific params (ref: sparkdl param/image_params.py).
+
+``CanLoadImage`` carries the user's URI→ndarray ``imageLoader`` callable
+and the internal loader that materializes image-struct columns from URI
+columns — the glue KerasImageFileTransformer/Estimator use to turn file
+paths into model-ready batches (ref: image_params.py CanLoadImage +
+loadImagesInternal).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudl.ml.params import Param, Params
+
+__all__ = ["CanLoadImage", "load_uri_batch"]
+
+
+class CanLoadImage(Params):
+    imageLoader = Param(
+        None, "imageLoader",
+        "callable URI -> ndarray (H, W, C) float/uint8 RGB, typically "
+        "decode+resize+preprocess for the target model")
+
+    def setImageLoader(self, value):
+        if not callable(value):
+            raise TypeError("imageLoader must be callable (URI -> ndarray)")
+        return self.set(self.imageLoader, value)
+
+    def getImageLoader(self):
+        return self.getOrDefault(self.imageLoader)
+
+    def loadImagesInternal(self, frame, inputCol: str):
+        """URI column → stacked float32 batch (N, H, W, C), loader-defined
+        geometry. Unloadable URIs raise — matching the estimator path's
+        strictness (the lenient null-row path is readImagesWithCustomFn)."""
+        return load_uri_batch(self.getImageLoader(), frame[inputCol])
+
+
+def load_uri_batch(loader, uris) -> np.ndarray:
+    """Apply ``loader`` to each URI and stack into one float32 batch —
+    shared by the estimator's bulk load and the file-transformer's
+    per-batch pack stage."""
+    arrays = []
+    for uri in uris:
+        arr = np.asarray(loader(uri))
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.ndim != 3:
+            raise ValueError(
+                f"imageLoader returned shape {arr.shape} for {uri!r}; "
+                "expected (H, W, C)")
+        arrays.append(arr.astype(np.float32))
+    if not arrays:
+        return np.zeros((0, 1, 1, 1), np.float32)
+    shapes = {a.shape for a in arrays}
+    if len(shapes) > 1:
+        raise ValueError(
+            f"imageLoader produced mixed shapes {sorted(shapes)}; the "
+            "loader must resize to a fixed geometry")
+    return np.stack(arrays)

@@ -1,0 +1,47 @@
+"""KerasTransformer — a saved Keras model over a 1-D tensor column.
+
+Rebuild of ref: python/sparkdl/transformers/keras_tensor.py (~L25):
+params ``modelFile`` (.keras/.h5), ``inputCol`` (array column),
+``outputCol``. Loads the model once on the host, ingests it to a jax fn
+(TFInputGraph.fromKeras), and delegates execution to the TFTransformer
+path — mirroring the reference's load→GraphFunction→TFTransformer
+delegation chain.
+"""
+
+from __future__ import annotations
+
+from tpudl.ml.params import (HasInputCol, HasKerasModel, HasOutputCol,
+                             keyword_only)
+from tpudl.ml.pipeline import Transformer
+
+__all__ = ["KerasTransformer"]
+
+
+class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
+                       HasKerasModel):
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
+                 batchSize=256, mesh=None):
+        super().__init__()
+        self.batchSize = int(batchSize)
+        self.mesh = mesh
+        kwargs = dict(self._input_kwargs)
+        kwargs.pop("batchSize", None)
+        kwargs.pop("mesh", None)
+        self._set(**kwargs)
+
+    def _transform(self, frame):
+        from tpudl.ingest import TFInputGraph
+        from tpudl.ml.tf_tensor import TFTransformer
+
+        gin = TFInputGraph.fromKeras(self.getModelFile())
+        if len(gin.input_names) != 1 or len(gin.output_names) != 1:
+            raise ValueError(
+                f"KerasTransformer requires a single-input single-output "
+                f"model; got {gin.input_names} -> {gin.output_names}")
+        delegate = TFTransformer(
+            tfInputGraph=gin,
+            inputMapping={self.getInputCol(): gin.input_names[0]},
+            outputMapping={gin.output_names[0]: self.getOutputCol()},
+            batchSize=self.batchSize, mesh=self.mesh)
+        return delegate.transform(frame)

@@ -1,0 +1,97 @@
+"""Keras-named losses and optimizers, jax/optax-backed.
+
+The reference passes loss/optimizer *names* through to Keras compile
+(ref: sparkdl param/converters.py toKerasLoss/toKerasOptimizer;
+estimators/keras_image_file_estimator.py kerasOptimizer/kerasLoss
+params). We keep the Keras spellings as the config vocabulary and bind
+them to jax loss fns and optax optimizers, so a sparkdl user's strings
+keep working while the arithmetic is XLA-fused into the train step.
+
+Losses take (pred, target) batches and return the mean scalar; preds are
+post-activation (probabilities), matching Keras's from_logits=False
+default that sparkdl models relied on.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LOSSES", "OPTIMIZERS", "get_loss", "get_optimizer"]
+
+_EPS = 1e-7  # keras backend epsilon
+
+
+def _mse(pred, y):
+    return jnp.mean(jnp.square(pred - y))
+
+
+def _mae(pred, y):
+    return jnp.mean(jnp.abs(pred - y))
+
+
+def _categorical_crossentropy(pred, y):
+    p = jnp.clip(pred, _EPS, 1.0 - _EPS)
+    return jnp.mean(-jnp.sum(y * jnp.log(p), axis=-1))
+
+
+def _sparse_categorical_crossentropy(pred, y):
+    p = jnp.clip(pred, _EPS, 1.0 - _EPS)
+    picked = jnp.take_along_axis(p, y[..., None].astype(jnp.int32), axis=-1)
+    return jnp.mean(-jnp.log(picked[..., 0]))
+
+
+def _binary_crossentropy(pred, y):
+    p = jnp.clip(pred, _EPS, 1.0 - _EPS)
+    return jnp.mean(-(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p)))
+
+
+LOSSES = {
+    "mse": _mse,
+    "mean_squared_error": _mse,
+    "mae": _mae,
+    "mean_absolute_error": _mae,
+    "categorical_crossentropy": _categorical_crossentropy,
+    "sparse_categorical_crossentropy": _sparse_categorical_crossentropy,
+    "binary_crossentropy": _binary_crossentropy,
+}
+
+# keras default learning rates, per optimizer
+_OPT_DEFAULT_LR = {
+    "sgd": 0.01,
+    "adam": 0.001,
+    "rmsprop": 0.001,
+    "adagrad": 0.001,
+    "adadelta": 0.001,
+    "adamax": 0.001,
+    "nadam": 0.001,
+}
+
+
+def _make_optimizer(name: str, learning_rate: float | None):
+    import optax
+
+    lr = learning_rate if learning_rate is not None else _OPT_DEFAULT_LR[name]
+    return {
+        "sgd": optax.sgd,
+        "adam": optax.adam,
+        "rmsprop": optax.rmsprop,
+        "adagrad": optax.adagrad,
+        "adadelta": optax.adadelta,
+        "adamax": optax.adamax,
+        "nadam": optax.nadam,
+    }[name](lr)
+
+
+OPTIMIZERS = frozenset(_OPT_DEFAULT_LR)
+
+
+def get_loss(name: str):
+    if name not in LOSSES:
+        raise KeyError(f"unknown loss {name!r}; one of {sorted(LOSSES)}")
+    return LOSSES[name]
+
+
+def get_optimizer(name: str, learning_rate: float | None = None):
+    if name not in OPTIMIZERS:
+        raise KeyError(f"unknown optimizer {name!r}; one of {sorted(OPTIMIZERS)}")
+    return _make_optimizer(name, learning_rate)

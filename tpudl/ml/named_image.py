@@ -1,0 +1,162 @@
+"""DeepImageFeaturizer / DeepImagePredictor — pretrained named models.
+
+Rebuild of ref: python/sparkdl/transformers/named_image.py
+(DeepImageFeaturizer ~L40, DeepImagePredictor ~L120,
+_NamedImageTransformer internal) and its JVM fast path
+src/main/scala/com/databricks/sparkdl/DeepImageFeaturizer.scala. The
+reference's "fast path" is graph surgery + TensorFrames JNI; ours is one
+jit-fused XLA program per batch: resize → channel-order fix → imagenet
+preprocess → zoo forward pass, data-parallel over the mesh. This is the
+benchmark path (BASELINE.json configs[0]).
+
+Weights: ``weights="random"`` (seeded, offline-friendly),
+``"imagenet"`` (converted from keras.applications when its cache exists),
+or a path to a .keras/.h5 model or an .npz param dump.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from tpudl.image import ops as image_ops
+from tpudl.ml.params import (HasInputCol, HasOutputCol, Param,
+                             TypeConverters, keyword_only)
+from tpudl.ml.pipeline import Transformer
+from tpudl.ml.tf_image import _pack_image_structs
+from tpudl.zoo.preprocessing import decode_predictions
+from tpudl.zoo.registry import SUPPORTED_MODELS, getKerasApplicationModel
+
+__all__ = ["DeepImageFeaturizer", "DeepImagePredictor"]
+
+_PARAMS_CACHE: dict[tuple[str, str], dict] = {}
+
+
+def load_named_params(model_name: str, weights: str = "random") -> dict:
+    """Resolve a named model's param pytree. The symbolic sources
+    ("random", "imagenet") are cached per model — the moral equivalent of
+    the reference broadcasting one GraphDef per model (Models.scala
+    packaged .pb resources). Path sources are re-read every call: the
+    file may have been rewritten (e.g. by a fit) since last load."""
+    cacheable = weights in ("random", "imagenet")
+    key = (model_name, weights)
+    if cacheable and key in _PARAMS_CACHE:
+        return _PARAMS_CACHE[key]
+    model = getKerasApplicationModel(model_name)
+    if weights == "random":
+        params = model.init(jax.random.key(0))
+    elif weights == "imagenet":
+        from tpudl.zoo.convert import params_from_keras
+
+        kmodel = model.keras_builder()(weights="imagenet")
+        params = params_from_keras(kmodel)
+    elif weights.endswith(".npz"):
+        with np.load(weights, allow_pickle=True) as z:
+            params = z["params"].item()
+    else:
+        from tpudl.zoo.convert import load_keras_model, params_from_keras
+
+        params = params_from_keras(load_keras_model(weights))
+    if cacheable:
+        _PARAMS_CACHE[key] = params
+    return params
+
+
+class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Shared engine (ref: named_image.py _NamedImageTransformer): packs
+    the image column, runs ONE fused program —
+    uint8 batch → float → resize(model geometry) → preprocess → net."""
+
+    modelName = Param(None, "modelName", "named model from the zoo registry",
+                      TypeConverters.supportedNameConverter(SUPPORTED_MODELS))
+
+    def setModelName(self, value):
+        return self.set(self.modelName, value)
+
+    def getModelName(self):
+        return self.getOrDefault(self.modelName)
+
+    def _head_fn(self, model, params):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _apply_batches(self, frame, out_col):
+        name = self.getModelName()
+        model = getKerasApplicationModel(name)
+        params = load_named_params(name, self.weights)
+        h, w = model.input_size
+        head = self._head_fn(model, params)
+
+        def fn(batch):
+            x = image_ops.to_model_input(batch, h, w, "BGR", "RGB")
+            x = model.preprocess(x)
+            return head(x)
+
+        return frame.map_batches(
+            jax.jit(fn), [self.getInputCol()], [out_col],
+            batch_size=self.batchSize, mesh=self.mesh,
+            pack=_pack_image_structs)
+
+
+class DeepImageFeaturizer(_NamedImageTransformer):
+    """Penultimate-layer feature vectors for transfer learning
+    (ref: named_image.py ~L40; Scala DeepImageFeaturizer.transform ~L80).
+    """
+
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
+                 weights="random", batchSize=64, mesh=None):
+        super().__init__()
+        self.weights = weights
+        self.batchSize = int(batchSize)
+        self.mesh = mesh
+        kwargs = dict(self._input_kwargs)
+        for k in ("weights", "batchSize", "mesh"):
+            kwargs.pop(k, None)
+        self._set(**kwargs)
+
+    def _head_fn(self, model, params):
+        return lambda x: model.featurize(params, x)
+
+    def _transform(self, frame):
+        return self._apply_batches(frame, self.getOutputCol())
+
+
+class DeepImagePredictor(_NamedImageTransformer):
+    """ImageNet class predictions, optionally decoded to (wnid, label,
+    score) topK rows (ref: named_image.py ~L120 — pipes through
+    TFImageTransformer + keras decode_predictions)."""
+
+    decodePredictions = Param(None, "decodePredictions",
+                              "decode scores to (wnid,label,score) topK",
+                              TypeConverters.toBoolean)
+    topK = Param(None, "topK", "how many predictions to keep",
+                 TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
+                 decodePredictions=False, topK=5, weights="random",
+                 batchSize=64, mesh=None):
+        super().__init__()
+        self._setDefault(decodePredictions=False, topK=5)
+        self.weights = weights
+        self.batchSize = int(batchSize)
+        self.mesh = mesh
+        kwargs = dict(self._input_kwargs)
+        for k in ("weights", "batchSize", "mesh"):
+            kwargs.pop(k, None)
+        self._set(**kwargs)
+
+    def _head_fn(self, model, params):
+        return lambda x: model.predict(params, x)
+
+    def _transform(self, frame):
+        out_col = self.getOutputCol()
+        out = self._apply_batches(frame, out_col)
+        if self.getOrDefault(self.decodePredictions):
+            scores = np.stack(list(out[out_col]))
+            decoded = decode_predictions(scores, top=self.getOrDefault(self.topK))
+            col = np.empty(len(decoded), dtype=object)  # keep tuples un-coerced
+            col[:] = decoded
+            out = out.drop(out_col).with_column(out_col, col)
+        return out

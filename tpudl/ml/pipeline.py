@@ -1,0 +1,111 @@
+"""Transformer / Estimator / Pipeline bases.
+
+The reference subclasses Spark ML's abstractions (pyspark.ml.Transformer/
+Estimator/Pipeline); here the same contract is owned directly over
+:class:`tpudl.frame.Frame` (SURVEY.md §7.0 capability 1). Semantics kept
+deliberately identical where sparkdl's code depends on them:
+
+- ``transform(frame, params)`` / ``fit(frame, params)`` accept an
+  optional {Param → value} override map, applied via ``copy(extra)``.
+- ``fit(frame, [pm1, pm2, ...])`` with a *list* returns a list of models
+  (Spark's multi-param-map fit — the HPO entry point).
+- ``Estimator.fitMultiple(frame, paramMaps)`` returns an iterator of
+  ``(index, model)`` *in completion order* (the upstream contract
+  CrossValidator consumes; SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+from tpudl.ml.params import Params
+
+__all__ = ["Transformer", "Estimator", "Model", "Pipeline", "PipelineModel"]
+
+
+class Transformer(Params):
+    def transform(self, frame, params: dict | None = None):
+        if params:
+            return self.copy(params)._transform(frame)
+        return self._transform(frame)
+
+    def _transform(self, frame):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer (keeps Spark's Estimator→Model naming)."""
+
+
+class Estimator(Params):
+    def fit(self, frame, params=None):
+        if isinstance(params, (list, tuple)):
+            models = [None] * len(params)
+            for i, m in self.fitMultiple(frame, list(params)):
+                models[i] = m
+            return models
+        if params:
+            return self.copy(params)._fit(frame)
+        return self._fit(frame)
+
+    def fitMultiple(self, frame, paramMaps):
+        """Iterator of (index, model) as each trial finishes. Default:
+        sequential fit of ``self.copy(pm)``; estimators override to
+        schedule trials onto the mesh (KerasImageFileEstimator does)."""
+        def gen():
+            for i, pm in enumerate(paramMaps):
+                yield i, self.copy(pm)._fit(frame)
+
+        return gen()
+
+    def _fit(self, frame):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Pipeline(Estimator):
+    """Ordered stages of Transformers/Estimators (pyspark.ml.Pipeline
+    ergonomics — sparkdl's README examples compose DeepImageFeaturizer
+    with downstream estimators through exactly this API)."""
+
+    def __init__(self, stages=None):
+        super().__init__()
+        self._stages = list(stages or [])
+
+    def setStages(self, stages):
+        self._stages = list(stages)
+        return self
+
+    def getStages(self):
+        return list(self._stages)
+
+    def _fit(self, frame):
+        bad = [s for s in self._stages
+               if not isinstance(s, (Transformer, Estimator))]
+        if bad:
+            raise TypeError(
+                f"pipeline stage must be Transformer or Estimator, got "
+                f"{type(bad[0]).__name__}")
+        # stages after the last estimator need no fit-time data pass
+        last_est = max((i for i, s in enumerate(self._stages)
+                        if isinstance(s, Estimator)), default=-1)
+        fitted = []
+        cur = frame
+        for i, stage in enumerate(self._stages):
+            if isinstance(stage, Estimator):
+                stage = stage.fit(cur)
+            fitted.append(stage)
+            if i < last_est:
+                cur = stage.transform(cur)
+        return PipelineModel(fitted)
+
+
+class PipelineModel(Model):
+    def __init__(self, stages):
+        super().__init__()
+        self._stages = list(stages)
+
+    def getStages(self):
+        return list(self._stages)
+
+    def _transform(self, frame):
+        for stage in self._stages:
+            frame = stage.transform(frame)
+        return frame

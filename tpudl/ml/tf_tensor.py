@@ -1,0 +1,76 @@
+"""TFTransformer — arbitrary ingested graph over tensor columns.
+
+Rebuild of ref: python/sparkdl/transformers/tf_tensor.py (~L35 class,
+~L80 _transform): params ``tfInputGraph`` (a TFInputGraph),
+``inputMapping`` {column → tensor name}, ``outputMapping`` {tensor name →
+column}. The reference imports the frozen graph and runs
+tfs.map_blocks; here the ingested graph is already a jax fn and runs as
+one jitted program per batch over the Frame executor.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tpudl.ml.params import Param, TypeConverters, keyword_only
+from tpudl.ml.pipeline import Transformer
+
+__all__ = ["TFTransformer"]
+
+
+class TFTransformer(Transformer):
+    tfInputGraph = Param(None, "tfInputGraph", "ingested TFInputGraph",
+                         TypeConverters.toTFInputGraph)
+    inputMapping = Param(None, "inputMapping", "{column -> input tensor name}",
+                         TypeConverters.asColumnToTensorNameMap)
+    outputMapping = Param(None, "outputMapping",
+                          "{output tensor name -> column}",
+                          TypeConverters.asTensorNameToColumnMap)
+
+    @keyword_only
+    def __init__(self, *, tfInputGraph=None, inputMapping=None,
+                 outputMapping=None, batchSize=256, mesh=None):
+        super().__init__()
+        self.batchSize = int(batchSize)
+        self.mesh = mesh
+        kwargs = dict(self._input_kwargs)
+        kwargs.pop("batchSize", None)
+        kwargs.pop("mesh", None)
+        self._set(**kwargs)
+
+    def setTfInputGraph(self, value):
+        return self.set(self.tfInputGraph, value)
+
+    def setInputMapping(self, value):
+        return self.set(self.inputMapping, value)
+
+    def setOutputMapping(self, value):
+        return self.set(self.outputMapping, value)
+
+    def _transform(self, frame):
+        gin = self.getOrDefault(self.tfInputGraph)
+        in_map = self.getOrDefault(self.inputMapping)    # col -> tensor
+        out_map = self.getOrDefault(self.outputMapping)  # tensor -> col
+
+        # signature logical names are accepted wherever tensor names are
+        # (ref: tf_tensor.py resolves via TFInputGraph's signature maps)
+        def resolve(tname, sig):
+            if sig and tname.split(":")[0] in sig:
+                return sig[tname.split(":")[0]]
+            return tname
+
+        feeds = [resolve(t, gin.input_tensor_name_from_signature)
+                 for t in in_map.values()]
+        fetches = [resolve(t, gin.output_tensor_name_from_signature)
+                   for t in out_map.keys()]
+        in_cols = list(in_map.keys())
+        out_cols = list(out_map.values())
+
+        fn = gin.make_fn(feeds, fetches)
+        if gin.trainable:
+            params = gin.params
+            jfn = jax.jit(lambda *xs: fn(params, *xs))
+        else:
+            jfn = jax.jit(fn)
+        return frame.map_batches(jfn, in_cols, out_cols,
+                                 batch_size=self.batchSize, mesh=self.mesh)
