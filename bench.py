@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""tpudl benchmark — the BASELINE.json headline config.
+
+Measures ``DeepImageFeaturizer(InceptionV3).transform`` throughput
+(images/sec/chip) on the default jax backend (the real TPU chip under
+the driver; CPU elsewhere) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` compares against the reference's execution substrate on
+this host — Keras/TF InceptionV3 inference on CPU (the reference
+publishes no numbers, BASELINE.md; we measure both sides ourselves).
+Set TPUDL_BENCH_SKIP_BASELINE=1 to skip the TF-CPU side (vs_baseline
+null), TPUDL_BENCH_N / _BATCH to resize the run.
+
+Everything except the final JSON line goes to stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_frame(n, h=299, w=299, seed=0):
+    from tpudl.frame import Frame
+    from tpudl.image import imageIO
+
+    rng = np.random.default_rng(seed)
+    structs = [
+        imageIO.imageArrayToStruct(
+            rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8),
+            origin=f"synthetic_{i}")
+        for i in range(n)
+    ]
+    return Frame({"image": structs})
+
+
+def measure_tpudl(n, batch):
+    import jax
+
+    from tpudl.ml import DeepImageFeaturizer
+    from tpudl.obs import Meter
+
+    devs = jax.devices()
+    log(f"backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind})")
+    feat = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                               modelName="InceptionV3", batchSize=batch)
+    meter = Meter(n_chips=1, skip=1)  # batch 0 = compile+warmup
+    with meter.batch(batch):
+        feat.transform(make_frame(batch))
+    log(f"compile+warmup: {meter.report()['batches']} batch in "
+        f"{sum(t for _n, t in meter._batches):.1f}s")
+
+    frame = make_frame(n)
+    with meter.batch(n):
+        out = feat.transform(frame)
+        np.asarray(out["features"][-1])  # materialized already; paranoia
+    r = meter.report()
+    log(f"tpudl featurize: {r['examples']} images in {r['seconds']}s -> "
+        f"{r['examples_per_sec_per_chip']} images/sec/chip")
+    return meter
+
+
+def measure_tf_cpu_baseline(k=64, batch=32):
+    """The reference path's substrate: Keras InceptionV3 (no top, avg
+    pool) on TF-CPU — what sparkdl's executors ran when no GPU was
+    present. Random weights; arithmetic cost is identical."""
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    import keras
+
+    log("building TF-CPU InceptionV3 baseline ...")
+    model = keras.applications.InceptionV3(weights=None, include_top=False,
+                                           pooling="avg")
+    x = np.random.default_rng(0).integers(
+        0, 256, size=(k, 299, 299, 3)).astype(np.float32)
+    x = x / 127.5 - 1.0
+    model.predict(x[:batch], batch_size=batch, verbose=0)  # warmup
+    t0 = time.perf_counter()
+    model.predict(x, batch_size=batch, verbose=0)
+    dt = time.perf_counter() - t0
+    ips = k / dt
+    log(f"TF-CPU baseline: {k} images in {dt:.2f}s -> {ips:.1f} images/sec")
+    return ips
+
+
+def main():
+    batch = int(os.environ.get("TPUDL_BENCH_BATCH", "64"))
+    n = int(os.environ.get("TPUDL_BENCH_N", "512"))
+    n = max(batch, n - n % batch)  # whole batches, at least one
+    meter = measure_tpudl(n, batch)
+
+    base = None
+    if os.environ.get("TPUDL_BENCH_SKIP_BASELINE", "0") != "1":
+        try:
+            base = measure_tf_cpu_baseline()
+        except Exception as e:  # baseline failure must not kill the bench
+            log(f"baseline measurement failed: {e!r}")
+
+    print(meter.json_line(
+        "images/sec/chip (DeepImageFeaturizer InceptionV3)", baseline=base),
+        flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Observability tests (SURVEY.md §5.1/§5.5)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpudl.obs import Meter, named_scope, profile
+
+
+def test_meter_report_and_json_line():
+    m = Meter(n_chips=2, skip=1)
+    with m.batch(10):
+        pass
+    with m.batch(10):
+        pass
+    r = m.report()
+    assert r["examples"] == 10  # first (warmup) batch skipped
+    assert r["batches"] == 2
+    assert r["examples_per_sec_per_chip"] * 2 == pytest.approx(
+        r["examples_per_sec"], rel=1e-4)
+    line = json.loads(m.json_line("images/sec/chip (test)", baseline=None))
+    assert line["unit"] == "images/sec/chip"
+    assert line["vs_baseline"] is None
+    line2 = json.loads(m.json_line("x", baseline=r["examples_per_sec_per_chip"]))
+    assert line2["vs_baseline"] == 1.0
+
+
+def test_named_scope_composes_with_jit():
+    @jax.jit
+    def f(x):
+        with named_scope("decode"):
+            y = x * 2
+        with named_scope("apply"):
+            return y + 1
+
+    np.testing.assert_array_equal(np.asarray(f(np.arange(3.0))),
+                                  [1.0, 3.0, 5.0])
+
+
+def test_profile_writes_trace(tmp_path):
+    d = str(tmp_path / "trace")
+    with profile(d):
+        jax.block_until_ready(jax.jit(lambda x: x + 1)(np.zeros(4)))
+    import os
+
+    files = [os.path.join(r, f) for r, _d, fs in os.walk(d) for f in fs]
+    assert files, "profiler produced no trace files"

@@ -126,5 +126,5 @@ def shard_batch(tree, mesh: Mesh, axis: str = DATA_AXIS):
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh):
     """Context manager installing ``mesh`` for sharding-annotated jit code."""
-    with jax.sharding.use_mesh(mesh):
+    with jax.sharding.set_mesh(mesh):
         yield mesh

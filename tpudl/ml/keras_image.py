@@ -11,9 +11,9 @@ host decode overlaps device compute batch-to-batch.
 
 from __future__ import annotations
 
-import numpy as np
+import os
 
-import jax
+import numpy as np
 
 from tpudl.image import imageIO
 from tpudl.ml.image_params import CanLoadImage
@@ -40,29 +40,35 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         self._set(**kwargs)
 
     def _transform(self, frame):
-        from tpudl.ingest import TFInputGraph
-
-        gin = TFInputGraph.fromKeras(self.getModelFile())
-        model_fn = gin.make_fn()
         mode = self.getOutputMode()
         loader = self.getImageLoader()
+        model_file = self.getModelFile()
 
         def pack(sl: np.ndarray) -> np.ndarray:
             from tpudl.ml.image_params import load_uri_batch
 
             return load_uri_batch(loader, sl)
 
-        def fn(batch):
-            y = model_fn(batch)
-            if isinstance(y, tuple):
-                y = y[0]
-            if mode == "vector":
-                return y.reshape(y.shape[0], -1)
-            return y
+        def build():
+            from tpudl.ingest import TFInputGraph
+
+            model_fn = TFInputGraph.fromKeras(model_file).make_fn()
+
+            def fn(batch):
+                y = model_fn(batch)
+                if isinstance(y, tuple):
+                    y = y[0]
+                if mode == "vector":
+                    return y.reshape(y.shape[0], -1)
+                return y
+
+            return fn
 
         out_col = self.getOutputCol()
+        jfn = self._cached_jit(
+            (model_file, os.path.getmtime(model_file), mode), build)
         out = frame.map_batches(
-            jax.jit(fn), [self.getInputCol()], [out_col],
+            jfn, [self.getInputCol()], [out_col],
             batch_size=self.batchSize, mesh=self.mesh, pack=pack)
         if mode == "image":
             structs = [

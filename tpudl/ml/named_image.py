@@ -16,6 +16,8 @@ or a path to a .keras/.h5 model or an .npz param dump.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -37,8 +39,10 @@ def load_named_params(model_name: str, weights: str = "random") -> dict:
     """Resolve a named model's param pytree. The symbolic sources
     ("random", "imagenet") are cached per model — the moral equivalent of
     the reference broadcasting one GraphDef per model (Models.scala
-    packaged .pb resources). Path sources are re-read every call: the
-    file may have been rewritten (e.g. by a fit) since last load."""
+    packaged .pb resources). Path sources are re-read on every call here;
+    note the transformer layer above additionally caches its compiled
+    program keyed on (path, mtime), so a rewrite within mtime granularity
+    can still serve the previous compile (see _apply_batches)."""
     cacheable = weights in ("random", "imagenet")
     key = (model_name, weights)
     if cacheable and key in _PARAMS_CACHE:
@@ -82,18 +86,27 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
 
     def _apply_batches(self, frame, out_col):
         name = self.getModelName()
-        model = getKerasApplicationModel(name)
-        params = load_named_params(name, self.weights)
-        h, w = model.input_size
-        head = self._head_fn(model, params)
 
-        def fn(batch):
-            x = image_ops.to_model_input(batch, h, w, "BGR", "RGB")
-            x = model.preprocess(x)
-            return head(x)
+        def build():
+            model = getKerasApplicationModel(name)
+            params = load_named_params(name, self.weights)
+            h, w = model.input_size
+            head = self._head_fn(model, params)
 
+            def fn(batch):
+                x = image_ops.to_model_input(batch, h, w, "BGR", "RGB")
+                x = model.preprocess(x)
+                return head(x)
+
+            return fn
+
+        if self.weights in ("random", "imagenet"):
+            key = (name, self.weights)
+        else:  # file-backed weights may be rewritten between calls
+            key = (name, self.weights, os.path.getmtime(self.weights))
+        jfn = self._cached_jit(key, build)
         return frame.map_batches(
-            jax.jit(fn), [self.getInputCol()], [out_col],
+            jfn, [self.getInputCol()], [out_col],
             batch_size=self.batchSize, mesh=self.mesh,
             pack=_pack_image_structs)
 

@@ -30,6 +30,19 @@ class Transformer(Params):
     def _transform(self, frame):  # pragma: no cover - abstract
         raise NotImplementedError
 
+    def _cached_jit(self, key, build):
+        """jit ``build()`` once per ``key`` and reuse across transform()
+        calls — a fresh closure per call would re-trace (and re-compile)
+        the whole XLA program every time. Keys compare with ``==``; put
+        the model object itself in the key for identity semantics, or a
+        (path, mtime) pair for file-backed models."""
+        import jax
+
+        if getattr(self, "_jit_key", None) != key:
+            self._jit_fn = jax.jit(build())
+            self._jit_key = key
+        return self._jit_fn
+
 
 class Model(Transformer):
     """A fitted Transformer (keeps Spark's Estimator→Model naming)."""

@@ -92,18 +92,25 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol,
         out_col = self.getOutputCol()
         order = self.getOrDefault(self.channelOrder)
         mode = self.getOutputMode()
-        model = self._model_fn()
 
-        def fn(batch):
-            # fused prologue + model + epilogue: one XLA program
-            x = image_ops.sp_image_converter(batch, "BGR", order) \
-                if order != "L" else batch.astype(np.float32)
-            y = model(x)
-            if isinstance(y, tuple):
-                y = y[0]
-            return image_ops.flattener(y) if mode == "vector" else y
+        def build():
+            model = self._model_fn()
 
-        jfn = jax.jit(fn)
+            def fn(batch):
+                # fused prologue + model + epilogue: one XLA program
+                x = image_ops.sp_image_converter(batch, "BGR", order) \
+                    if order != "L" else batch.astype(np.float32)
+                y = model(x)
+                if isinstance(y, tuple):
+                    y = y[0]
+                return image_ops.flattener(y) if mode == "vector" else y
+
+            return fn
+
+        jfn = self._cached_jit(
+            (self.getOrDefault(self.graph),
+             self._paramMap.get(self.inputTensor),
+             self._paramMap.get(self.outputTensor), order, mode), build)
         out = frame.map_batches(
             jfn, [in_col], [out_col], batch_size=self.batchSize,
             mesh=self.mesh, pack=_pack_image_structs)

@@ -10,8 +10,6 @@ one jitted program per batch over the Frame executor.
 
 from __future__ import annotations
 
-import jax
-
 from tpudl.ml.params import Param, TypeConverters, keyword_only
 from tpudl.ml.pipeline import Transformer
 
@@ -66,11 +64,14 @@ class TFTransformer(Transformer):
         in_cols = list(in_map.keys())
         out_cols = list(out_map.values())
 
-        fn = gin.make_fn(feeds, fetches)
-        if gin.trainable:
-            params = gin.params
-            jfn = jax.jit(lambda *xs: fn(params, *xs))
-        else:
-            jfn = jax.jit(fn)
+        def build():
+            fn = gin.make_fn(feeds, fetches)
+            if gin.trainable:
+                params = gin.params
+                return lambda *xs: fn(params, *xs)
+            return fn
+
+        jfn = self._cached_jit(
+            (gin, tuple(feeds), tuple(fetches)), build)
         return frame.map_batches(jfn, in_cols, out_cols,
                                  batch_size=self.batchSize, mesh=self.mesh)

@@ -1,0 +1,81 @@
+"""Observability: profiling hooks + step metrics.
+
+SURVEY.md §5.1/§5.5: the reference has NO first-party tracing or metrics
+(observability was inherited from the Spark UI). This layer is the cheap
+real win the survey calls for: jax.profiler traces, named scopes around
+the pipeline stages (decode/infeed/apply show up as labeled spans in the
+trace viewer), and a throughput meter that computes the judged metric
+(images/sec/chip) inside the framework itself.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+import jax
+
+__all__ = ["profile", "named_scope", "Meter"]
+
+
+@contextlib.contextmanager
+def profile(log_dir: str):
+    """Capture a jax.profiler trace for the enclosed block; view with
+    tensorboard-plugin-profile or xprof against ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+named_scope = jax.named_scope  # label pipeline stages inside jitted code
+
+
+class Meter:
+    """Throughput/latency meter for the executor hot loop.
+
+    ``with meter.batch(n):`` around each device call; ``meter.report()``
+    yields {examples, seconds, examples_per_sec, examples_per_sec_per_chip}.
+    Warmup batches (compile) can be excluded via ``skip`` — report both
+    cold and warm numbers, never silently drop the compile cost.
+    """
+
+    def __init__(self, n_chips: int = 1, skip: int = 0):
+        self.n_chips = max(1, int(n_chips))
+        self.skip = int(skip)
+        self._batches: list[tuple[int, float]] = []
+
+    @contextlib.contextmanager
+    def batch(self, n_examples: int):
+        t0 = time.perf_counter()
+        yield
+        self._batches.append((int(n_examples), time.perf_counter() - t0))
+
+    def report(self) -> dict:
+        counted = self._batches[self.skip:]
+        ex = sum(n for n, _ in counted)
+        secs = sum(t for _, t in counted)
+        all_ex = sum(n for n, _ in self._batches)
+        all_secs = sum(t for _, t in self._batches)
+        eps = ex / secs if secs > 0 else 0.0
+        return {
+            "examples": ex,
+            "seconds": round(secs, 4),
+            "examples_per_sec": round(eps, 2),
+            "examples_per_sec_per_chip": round(eps / self.n_chips, 2),
+            "cold_examples_per_sec": round(all_ex / all_secs, 2)
+            if all_secs > 0 else 0.0,
+            "batches": len(self._batches),
+        }
+
+    def json_line(self, metric: str, baseline: float | None = None) -> str:
+        r = self.report()
+        value = r["examples_per_sec_per_chip"]
+        return json.dumps({
+            "metric": metric,
+            "value": value,
+            "unit": "images/sec/chip",
+            "vs_baseline": round(value / baseline, 3) if baseline else None,
+        })

@@ -1,0 +1,20 @@
+"""Distributed training layer (SURVEY.md §2.1 L7 / §5.8).
+
+HorovodRunner's MPI+NCCL contract re-owned as SPMD over the jax mesh:
+see :mod:`tpudl.train.runner` (Runner/Trainer), :mod:`tpudl.train.step`
+(the allreduce-equivalent jitted step), :mod:`tpudl.train.checkpoint`
+(orbax checkpoint/resume — first-class, unlike the reference).
+"""
+
+from tpudl.train.checkpoint import CheckpointManager
+from tpudl.train.runner import HorovodRunner, TrainContext, Trainer
+from tpudl.train.step import make_eval_step, make_train_step
+
+__all__ = [
+    "HorovodRunner",
+    "TrainContext",
+    "Trainer",
+    "CheckpointManager",
+    "make_train_step",
+    "make_eval_step",
+]

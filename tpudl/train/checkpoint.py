@@ -1,0 +1,87 @@
+"""Checkpoint / resume — first-class, unlike the reference.
+
+SURVEY.md §5.4: the reference only *consumes* checkpoints
+(TFInputGraph.fromCheckpoint) and returns final HDF5 blobs; there is no
+periodic checkpoint/resume loop anywhere in its tree. Here it is a core
+subsystem: orbax-backed sharded checkpoints of the whole training state
+(params + opt_state + step + data cursor), periodic saves, latest-wins
+restore — the substrate for the Runner's fault recovery (§5.3: SPMD
+programs die together; recovery is restart-from-last-checkpoint).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    """Thin veneer over orbax's CheckpointManager holding the
+    {params, opt_state, step, cursor} training-state pytree."""
+
+    def __init__(self, directory: str, *, save_every: int = 100,
+                 max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self.save_every = int(save_every)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    # -- write -------------------------------------------------------------
+    def save(self, step: int, state: dict, *, force: bool = False) -> bool:
+        """Save if ``step`` hits the cadence (or ``force``). Blocking save
+        is deliberate: resume-equivalence tests require the write to be
+        durable before the step counter advances."""
+        import orbax.checkpoint as ocp
+
+        if not force and (self.save_every <= 0
+                          or step % self.save_every != 0):
+            return False
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+        return True
+
+    def maybe_save(self, step: int, state: dict) -> bool:
+        return self.save(step, state)
+
+    # -- read --------------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int | None = None, *, like: dict | None = None):
+        """Restore the state pytree at ``step`` (default latest). ``like``
+        provides the target structure/shardings (orbax restores device-
+        sharded arrays directly when given abstract targets)."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        if like is not None:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        return self._mgr.restore(step)
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def as_numpy_state(state: dict) -> dict:
+    """Device pytree → host numpy (for handing across process restarts)."""
+    return jax.tree.map(lambda x: np.asarray(x), state)

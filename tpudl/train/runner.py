@@ -1,0 +1,209 @@
+"""HorovodRunner contract + the training loop that owns it.
+
+Rebuild of the L7 capability surface (SURVEY.md §3.6): the Databricks
+``sparkdl.HorovodRunner(np=N).run(train_fn, **kwargs)`` API — MPI gang
+launch + NCCL allreduce — re-owned as SPMD over a jax mesh:
+
+- ``np > 0``: data-parallel mesh over the first ``np`` local devices
+  (the reference's N distributed GPU ranks → N TPU chips on the slice).
+- ``np < 0``: |np|-device debug mesh, mirroring HorovodRunner's
+  negative-np local-mode debugging contract (runs on whatever local
+  devices exist; under the CPU simulation flag this is a real multi-
+  device mesh on one host).
+
+Differences owned deliberately (NOT ported): there are no per-rank
+processes and no hvd.* mutable global — ``train_fn`` receives a
+:class:`TrainContext` as its first argument and is executed ONCE as an
+SPMD program driver. Rank-0-only conventions collapse: in SPMD the
+driver *is* logically rank 0 (``ctx.rank == 0`` is kept for code that
+checks it). Gang semantics match TPU reality (§5.3): a failure kills the
+whole program; ``max_restarts`` re-launches ``train_fn`` which resumes
+from the last checkpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import numpy as np
+
+from tpudl import mesh as M
+from tpudl.train.checkpoint import CheckpointManager
+from tpudl.train.step import make_train_step
+
+__all__ = ["HorovodRunner", "TrainContext", "Trainer"]
+
+log = logging.getLogger("tpudl.train")
+
+
+class TrainContext:
+    """What a ``train_fn`` gets instead of the hvd.* globals."""
+
+    def __init__(self, mesh, checkpoint_dir=None, save_every=100):
+        self.mesh = mesh
+        self.checkpoint_dir = checkpoint_dir
+        self.save_every = save_every
+        self.attempt = 0  # restart count, set by the runner
+
+    # hvd-parity accessors
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[M.DATA_AXIS]
+
+    @property
+    def rank(self) -> int:
+        return 0  # SPMD driver == logical rank 0 (see module docstring)
+
+    # mesh edges
+    def shard_batch(self, tree):
+        return M.shard_batch(tree, self.mesh)
+
+    def replicate(self, tree):
+        return M.replicate(tree, self.mesh)
+
+    def checkpoints(self, subdir: str | None = None) -> CheckpointManager | None:
+        if self.checkpoint_dir is None:
+            return None
+        d = self.checkpoint_dir if subdir is None else f"{self.checkpoint_dir}/{subdir}"
+        return CheckpointManager(d, save_every=self.save_every)
+
+    def trainer(self, loss_fn, optimizer, **kw) -> "Trainer":
+        kw.setdefault("checkpoint_dir", self.checkpoint_dir)
+        kw.setdefault("save_every", self.save_every)
+        return Trainer(loss_fn, optimizer, mesh=self.mesh, **kw)
+
+
+class HorovodRunner:
+    """``HorovodRunner(np=2).run(train_fn)`` — the reference's public
+    training entry point, mesh-native."""
+
+    def __init__(self, np: int = -1, *, checkpoint_dir: str | None = None,
+                 save_every: int = 100, max_restarts: int = 0,
+                 devices=None):
+        self._np = int(np)
+        self.checkpoint_dir = checkpoint_dir
+        self.save_every = save_every
+        self.max_restarts = int(max_restarts)
+        self._devices = devices
+
+    def _build_mesh(self):
+        devs = list(self._devices) if self._devices else jax.devices()
+        n = abs(self._np) if self._np != 0 else len(devs)
+        if n > len(devs):
+            raise ValueError(
+                f"HorovodRunner(np={self._np}) needs {n} devices, have "
+                f"{len(devs)} ({devs[0].platform})")
+        return M.build_mesh(n_data=n, devices=devs[:n])
+
+    def run(self, main, **kwargs):
+        """Run ``main(ctx, **kwargs)`` over the mesh; on exception,
+        re-launch up to ``max_restarts`` times (gang restart semantics —
+        main must resume from its checkpoints; Trainer does)."""
+        mesh = self._build_mesh()
+        ctx = TrainContext(mesh, self.checkpoint_dir, self.save_every)
+        attempt = 0
+        while True:
+            ctx.attempt = attempt
+            try:
+                with M.use_mesh(mesh):
+                    return main(ctx, **kwargs)
+            except Exception:
+                attempt += 1
+                if attempt > self.max_restarts:
+                    raise
+                log.exception(
+                    "train_fn failed; gang restart %d/%d from last "
+                    "checkpoint", attempt, self.max_restarts)
+
+
+class Trainer:
+    """Step-loop engine: sharded batches → one jitted SPMD step, periodic
+    orbax checkpoints, resume, throughput metrics.
+
+    ``data_fn(step) -> tuple_of_host_arrays`` must be stateless in
+    ``step`` (index-addressable), which makes the data cursor exactly the
+    step counter — resume is then correct by construction.
+    """
+
+    def __init__(self, loss_fn, optimizer, *, mesh=None,
+                 checkpoint_dir=None, save_every=100, log_every=0):
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.checkpoint_dir = checkpoint_dir
+        self.save_every = save_every
+        self.log_every = log_every
+        self.history: list[dict] = []
+
+    def fit(self, params, data_fn, steps: int, *, opt_state=None):
+        """Train for ``steps`` total steps (resuming included). Returns
+        (params, opt_state, history)."""
+        opt_state = (self.optimizer.init(params)
+                     if opt_state is None else opt_state)
+        self.history = []  # per-fit; stale entries would misreport results
+        start = 0
+        mgr = None
+        if self.checkpoint_dir is not None:
+            mgr = CheckpointManager(self.checkpoint_dir,
+                                    save_every=self.save_every)
+            like = {"params": params, "opt_state": opt_state,
+                    "step": np.asarray(0, np.int64)}
+            restored = mgr.restore(like=like)
+            if restored is not None:
+                params = restored["params"]
+                opt_state = restored["opt_state"]
+                start = int(restored["step"])
+                log.info("resumed from checkpoint at step %d", start)
+
+        step_fn = make_train_step(self.loss_fn, self.optimizer, self.mesh)
+        # own the buffers: the step donates params/opt_state, and device_put
+        # may alias the caller's arrays — donating an alias would delete the
+        # caller's data out from under them. Host-side copy is placement-
+        # neutral (valid under any active mesh context).
+        params = jax.tree.map(np.asarray, params)
+        opt_state = jax.tree.map(np.asarray, opt_state)
+        if self.mesh is not None:
+            params = M.replicate(params, self.mesh)
+            opt_state = M.replicate(opt_state, self.mesh)
+
+        t0 = time.perf_counter()
+        examples = 0
+        loss = None
+        try:
+            for step in range(start, steps):
+                batch = data_fn(step)
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+                if self.mesh is not None:
+                    batch = tuple(M.shard_batch(b, self.mesh) for b in batch)
+                params, opt_state, loss = step_fn(params, opt_state, *batch)
+                examples += int(np.shape(batch[0])[0])
+                done = step + 1
+                if mgr is not None and done < steps:
+                    if mgr.maybe_save(done, {"params": params,
+                                             "opt_state": opt_state,
+                                             "step": np.asarray(done, np.int64)}):
+                        log.debug("checkpoint at step %d", done)
+                if self.log_every and done % self.log_every == 0:
+                    dt = time.perf_counter() - t0
+                    l = float(jax.device_get(loss))
+                    self.history.append(
+                        {"step": done, "loss": l,
+                         "examples_per_sec": examples / max(dt, 1e-9)})
+                    log.info("step %d loss %.5f (%.1f ex/s)", done, l,
+                             examples / max(dt, 1e-9))
+            if loss is not None and (not self.history
+                                     or self.history[-1]["step"] != steps):
+                dt = time.perf_counter() - t0
+                self.history.append(
+                    {"step": steps, "loss": float(jax.device_get(loss)),
+                     "examples_per_sec": examples / max(dt, 1e-9)})
+            if mgr is not None and steps > start:
+                mgr.save(steps, {"params": params, "opt_state": opt_state,
+                                 "step": np.asarray(steps, np.int64)}, force=True)
+        finally:
+            if mgr is not None:
+                mgr.close()
+        return params, opt_state, self.history
