@@ -50,8 +50,13 @@ def measure_tpudl(n, batch):
 
     devs = jax.devices()
     log(f"backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind})")
+    dtype = os.environ.get("TPUDL_BENCH_DTYPE", "bfloat16")
+    log(f"compute dtype: {dtype} (standard TPU inference precision; "
+        "set TPUDL_BENCH_DTYPE=float32 for full-precision numbers)")
     feat = DeepImageFeaturizer(inputCol="image", outputCol="features",
-                               modelName="InceptionV3", batchSize=batch)
+                               modelName="InceptionV3", batchSize=batch,
+                               computeDtype=dtype)
+    measure_tpudl.dtype = dtype  # surfaced in the JSON line
     meter = Meter(n_chips=1, skip=1)  # batch 0 = compile+warmup
     with meter.batch(batch):
         feat.transform(make_frame(batch))
@@ -105,7 +110,10 @@ def main():
             log(f"baseline measurement failed: {e!r}")
 
     print(meter.json_line(
-        "images/sec/chip (DeepImageFeaturizer InceptionV3)", baseline=base),
+        "images/sec/chip (DeepImageFeaturizer InceptionV3)", baseline=base,
+        extra={"compute_dtype": getattr(measure_tpudl, "dtype", "float32"),
+               "batch_size": batch,
+               "baseline": "keras InceptionV3 on TF-CPU (fp32), this host"}),
         flush=True)
 
 

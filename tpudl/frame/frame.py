@@ -166,6 +166,7 @@ class Frame:
         if missing:
             raise KeyError(f"unknown input columns {missing}")
         outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
+        pending: list[tuple[tuple, int]] = []
         for start, stop in self.iter_batches(batch_size):
             packed = []
             for c in input_cols:
@@ -185,11 +186,15 @@ class Frame:
                 raise ValueError(
                     f"fn returned {len(result)} outputs, expected {len(output_cols)}"
                 )
-            for i, r in enumerate(result):
-                r = np.asarray(r)
-                if n_pad:
-                    r = r[: r.shape[0] - n_pad]
-                outputs[i].append(r)
+            # pipeline window: dispatch is async, so deferring the host
+            # copy lets batch k's compute overlap batch k+1's host pack
+            # (SURVEY.md §3.2); the window is bounded so device memory
+            # stays O(window · batch), not O(rows).
+            pending.append((tuple(result), n_pad))
+            if len(pending) > _PIPELINE_WINDOW:
+                _drain(pending.pop(0), outputs)
+        while pending:
+            _drain(pending.pop(0), outputs)
         out = self
         for name, chunks in zip(output_cols, outputs):
             col = np.concatenate(chunks, axis=0) if chunks else np.empty((0,))
@@ -199,6 +204,16 @@ class Frame:
                 col = obj
             out = out.with_column(name, col)
         return out
+
+
+_PIPELINE_WINDOW = 2  # in-flight device batches retained before fetch
+
+
+def _drain(entry, outputs):
+    (result, n_pad) = entry
+    for i, r in enumerate(result):
+        r = np.asarray(r)  # device→host; blocks until this batch is done
+        outputs[i].append(r[: r.shape[0] - n_pad] if n_pad else r)
 
 
 def _default_pack(sl: np.ndarray) -> np.ndarray:

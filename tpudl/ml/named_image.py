@@ -67,6 +67,16 @@ def load_named_params(model_name: str, weights: str = "random") -> dict:
     return params
 
 
+_COMPUTE_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def _check_compute_dtype(value: str) -> str:
+    if value not in _COMPUTE_DTYPES:
+        raise ValueError(
+            f"computeDtype must be one of {_COMPUTE_DTYPES}, got {value!r}")
+    return value
+
+
 class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
     """Shared engine (ref: named_image.py _NamedImageTransformer): packs
     the image column, runs ONE fused program —
@@ -86,24 +96,36 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
 
     def _apply_batches(self, frame, out_col):
         name = self.getModelName()
+        dtype = self.computeDtype
 
         def build():
+            import jax.numpy as jnp
+
             model = getKerasApplicationModel(name)
             params = load_named_params(name, self.weights)
+            if dtype != "float32":
+                # MXU-native precision: bf16 params+activations, fp32 in
+                # the decode/preprocess prologue and the output epilogue
+                params = jax.tree.map(
+                    lambda p: p.astype(dtype)
+                    if jnp.issubdtype(np.asarray(p).dtype if not hasattr(
+                        p, "dtype") else p.dtype, jnp.floating)
+                    else p, params)
             h, w = model.input_size
             head = self._head_fn(model, params)
 
             def fn(batch):
                 x = image_ops.to_model_input(batch, h, w, "BGR", "RGB")
                 x = model.preprocess(x)
-                return head(x)
+                y = head(x.astype(dtype))
+                return y.astype(jnp.float32)
 
             return fn
 
         if self.weights in ("random", "imagenet"):
-            key = (name, self.weights)
+            key = (name, self.weights, dtype)
         else:  # file-backed weights may be rewritten between calls
-            key = (name, self.weights, os.path.getmtime(self.weights))
+            key = (name, self.weights, dtype, os.path.getmtime(self.weights))
         jfn = self._cached_jit(key, build)
         return frame.map_batches(
             jfn, [self.getInputCol()], [out_col],
@@ -118,13 +140,15 @@ class DeepImageFeaturizer(_NamedImageTransformer):
 
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
-                 weights="random", batchSize=64, mesh=None):
+                 weights="random", batchSize=64, mesh=None,
+                 computeDtype="float32"):
         super().__init__()
         self.weights = weights
         self.batchSize = int(batchSize)
         self.mesh = mesh
+        self.computeDtype = _check_compute_dtype(computeDtype)
         kwargs = dict(self._input_kwargs)
-        for k in ("weights", "batchSize", "mesh"):
+        for k in ("weights", "batchSize", "mesh", "computeDtype"):
             kwargs.pop(k, None)
         self._set(**kwargs)
 
@@ -149,14 +173,15 @@ class DeepImagePredictor(_NamedImageTransformer):
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  decodePredictions=False, topK=5, weights="random",
-                 batchSize=64, mesh=None):
+                 batchSize=64, mesh=None, computeDtype="float32"):
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5)
         self.weights = weights
         self.batchSize = int(batchSize)
         self.mesh = mesh
+        self.computeDtype = _check_compute_dtype(computeDtype)
         kwargs = dict(self._input_kwargs)
-        for k in ("weights", "batchSize", "mesh"):
+        for k in ("weights", "batchSize", "mesh", "computeDtype"):
             kwargs.pop(k, None)
         self._set(**kwargs)
 

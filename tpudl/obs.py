@@ -70,12 +70,16 @@ class Meter:
             "batches": len(self._batches),
         }
 
-    def json_line(self, metric: str, baseline: float | None = None) -> str:
+    def json_line(self, metric: str, baseline: float | None = None,
+                  extra: dict | None = None) -> str:
         r = self.report()
         value = r["examples_per_sec_per_chip"]
-        return json.dumps({
+        out = {
             "metric": metric,
             "value": value,
             "unit": "images/sec/chip",
             "vs_baseline": round(value / baseline, 3) if baseline else None,
-        })
+        }
+        if extra:
+            out.update(extra)
+        return json.dumps(out)
