@@ -1,0 +1,62 @@
+"""GraphFunction composition tests — rebuild of the reference's
+python/tests/graph/test_builder.py (SURVEY.md §4): compose tiny pieces,
+check fromList pipe equals the composed local run."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.ingest.builder import GraphFunction, IsolatedSession
+
+
+def test_from_list_pipes_and_fuses():
+    g1 = GraphFunction(lambda x: x * 3.0, ["x"], ["y"])
+    g2 = GraphFunction(lambda y: y + 4.0, ["y"], ["z"])
+    piped = GraphFunction.fromList([("scale", g1), ("shift", g2)])
+    x = np.arange(5.0, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(jax.jit(piped.fn)(x)), x * 3 + 4)
+    assert piped.input_names == ["scale/x:0"]
+    assert piped.output_names == ["shift/z:0"]
+
+
+def test_from_list_arity_mismatch():
+    g1 = GraphFunction(lambda x: (x, x), ["x"], ["a", "b"])
+    g2 = GraphFunction(lambda y: y, ["y"], ["z"])
+    with pytest.raises(ValueError, match="cannot pipe"):
+        GraphFunction.fromList([("two", g1), ("one", g2)])
+
+
+def test_multi_output_chain():
+    g1 = GraphFunction(lambda x: (x + 1, x - 1), ["x"], ["hi", "lo"])
+    g2 = GraphFunction(lambda a, b: a * b, ["a", "b"], ["prod"])
+    piped = GraphFunction.fromList([("", g1), ("", g2)])
+    np.testing.assert_allclose(piped(np.float32(3.0)), 8.0)  # (4)*(2)
+
+
+def test_from_keras_roundtrip():
+    keras = pytest.importorskip("keras")
+
+    keras.utils.set_random_seed(0)
+    m = keras.Sequential([keras.layers.Input((3,)),
+                          keras.layers.Dense(2, activation="tanh")])
+    gfn = GraphFunction.fromKeras(m)
+    x = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(gfn(x)),
+                               m.predict(x, verbose=0), rtol=1e-5,
+                               atol=1e-6)
+    # splice a normalizer in front, reference-style composition
+    pre = GraphFunction(lambda x: x / 2.0, ["raw"], ["scaled"])
+    piped = GraphFunction.fromList([("pre", pre), ("net", gfn)])
+    np.testing.assert_allclose(np.asarray(piped(x)),
+                               m.predict(x / 2.0, verbose=0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_isolated_session_shim():
+    with IsolatedSession(using_keras=True) as issn:
+        gfn = issn.asGraphFunction(lambda x: jnp.square(x), ["x"], ["y"])
+        imported = issn.importGraphFunction(gfn, prefix="m")
+    assert imported.input_names == ["m/x:0"]
+    np.testing.assert_allclose(imported(np.float32(3.0)), 9.0)

@@ -1,0 +1,76 @@
+"""LogisticRegression + the flagship transfer-learning pipeline
+(upstream README's DeepImageFeaturizer → LogisticRegression example)."""
+
+import numpy as np
+import pytest
+
+from tpudl.frame import Frame
+from tpudl.image import imageIO
+from tpudl.ml.classification import LogisticRegression
+
+
+def test_separable_blobs_converge():
+    rng = np.random.default_rng(0)
+    X0 = rng.normal(size=(60, 5)) + 2.0
+    X1 = rng.normal(size=(60, 5)) - 2.0
+    X = np.concatenate([X0, X1]).astype(np.float32)
+    y = np.array([0] * 60 + [1] * 60)
+    frame = Frame({"features": X, "label": y})
+    model = LogisticRegression(maxIter=200).fit(frame)
+    out = model.transform(frame)
+    acc = (np.asarray(out["prediction"]) == y).mean()
+    assert acc > 0.98, f"accuracy {acc}"
+    probs = np.stack(list(out["probability"]))
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_multiclass_and_param_overrides():
+    rng = np.random.default_rng(1)
+    X = np.concatenate([rng.normal(size=(40, 3)) + c * 3 for c in range(3)])
+    y = np.repeat(np.arange(3), 40)
+    frame = Frame({"feats": X.astype(np.float32), "cls": y})
+    lr = LogisticRegression(featuresCol="feats", labelCol="cls",
+                            predictionCol="yhat", maxIter=150)
+    model = lr.fit(frame)
+    assert model.numClasses == 3
+    acc = (np.asarray(model.transform(frame)["yhat"]) == y).mean()
+    assert acc > 0.95
+
+    # regParam shrinks weights
+    strong = LogisticRegression(featuresCol="feats", labelCol="cls",
+                                maxIter=150, regParam=1.0).fit(frame)
+    assert np.linalg.norm(strong.w) < np.linalg.norm(model.w)
+
+
+def test_transfer_learning_pipeline_end_to_end():
+    """featurize → logistic regression in ONE Pipeline — the sparkdl
+    headline workflow, on the simulated mesh."""
+    from tpudl.ml import DeepImageFeaturizer, Pipeline
+
+    rng = np.random.default_rng(2)
+    structs, labels = [], []
+    for i in range(16):
+        cls = i % 2
+        arr = rng.integers(0, 255, size=(48, 48, 3), dtype=np.uint8)
+        if cls:  # class 1 images are bright red-ish
+            arr[:, :, 2] = np.minimum(255, arr[:, :, 2] + 120)
+        structs.append(imageIO.imageArrayToStruct(arr))
+        labels.append(cls)
+    frame = Frame({"image": structs, "label": np.array(labels)})
+
+    pipe = Pipeline([
+        DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName="ResNet50", batchSize=8),
+        LogisticRegression(maxIter=200, learningRate=0.05),
+    ])
+    model = pipe.fit(frame)
+    out = model.transform(frame)
+    acc = (np.asarray(out["prediction"]) == np.array(labels)).mean()
+    assert acc >= 0.9, f"transfer-learning accuracy {acc}"
+
+
+def test_empty_frame_error():
+    frame = Frame({"features": np.zeros((0, 4), np.float32),
+                   "label": np.array([], np.int64)})
+    with pytest.raises(ValueError, match="empty"):
+        LogisticRegression().fit(frame)

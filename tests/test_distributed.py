@@ -1,0 +1,46 @@
+"""Multi-host control-plane helpers (single-process behaviors + shard
+math; real multi-host is exercised by the same code paths with
+process_count > 1 — SURVEY.md §4's argued-by-construction posture, same
+as the reference's local[*] trick)."""
+
+import numpy as np
+
+import jax
+
+from tpudl import distributed as D
+from tpudl import mesh as M
+
+
+def test_single_host_identities():
+    D.initialize()  # must be a no-op single-host
+    assert D.process_count() == 1
+    assert D.process_index() == 0
+    assert D.is_primary()
+
+
+def test_host_shard_single():
+    items = list(range(10))
+    assert D.host_shard(items) == items
+
+
+def test_host_shard_math_multi():
+    items = list(range(10))
+    shards = [D.host_shard(items, index=i, count=4) for i in range(4)]
+    assert all(len(s) == 3 for s in shards)  # ceil(10/4), padded by wrap
+    flat = [x for s in shards for x in s]
+    assert set(flat) == set(items)  # every item assigned somewhere
+    assert shards[0] == [0, 1, 2]
+    assert shards[3][:1] == [9]  # last shard starts at its slice...
+    assert len(shards[3]) == 3   # ...and wraps to equal length
+
+
+def test_global_batch_single_process(mesh8):
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = D.global_batch(x, mesh8)
+    assert arr.shape == (16, 3)
+    # sharded over the data axis
+    assert len(arr.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # and consumable by a jitted reduction
+    total = jax.jit(lambda a: a.sum())(arr)
+    assert float(total) == x.sum()

@@ -29,7 +29,10 @@ _LAZY = {
     "PipelineModel": "tpudl.ml",
     "TFInputGraph": "tpudl.ingest",
     "KerasImageFileEstimator": "tpudl.ml.estimator",
+    "LogisticRegression": "tpudl.ml",
     "registerKerasImageUDF": "tpudl.udf.keras_image_model",
+    "GraphFunction": "tpudl.ingest",
+    "IsolatedSession": "tpudl.ingest",
 }
 
 __all__ = ["__version__", *_LAZY]

@@ -1,0 +1,101 @@
+"""Multi-host control plane + per-host input sharding.
+
+The TPU-native replacement for the reference's cluster plumbing
+(SURVEY.md §5.8): py4j + Spark netty RPC become
+``jax.distributed.initialize`` (one Python runtime per host, coordinator
+over DCN); Spark partition shipping becomes per-host file sharding +
+``jax.make_array_from_process_local_data`` (each host feeds its local
+slice of the global batch; XLA's collectives ride ICI/DCN).
+
+Single-host (the dev box, CI) is the degenerate case: every helper works
+unchanged with process_count == 1, so the same user code runs from
+laptop mesh-simulation to a multi-host pod.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+
+__all__ = [
+    "initialize",
+    "process_count",
+    "process_index",
+    "is_primary",
+    "host_shard",
+    "global_batch",
+]
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None, **kwargs) -> None:
+    """Join the multi-host gang. No-op on a single host with no
+    coordinator configured (env-driven TPU pods need no arguments —
+    jax autodetects; explicit args are for DCN/GPU-style bring-up)."""
+    if coordinator_address is None and num_processes is None:
+        # TPU pod slices autodetect via the runtime; bare single host
+        # needs no distributed init at all.
+        try:
+            if jax.process_count() > 1:
+                return  # already initialized by the runtime
+        except RuntimeError:
+            pass
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the logical coordinator host (checkpoint writes, logging —
+    the reference's rank-0 convention)."""
+    return jax.process_index() == 0
+
+
+def host_shard(items: Sequence, *, index: int | None = None,
+               count: int | None = None) -> list:
+    """This host's contiguous slice of a global work list (files, URIs).
+
+    Replaces Spark's partition assignment: each host reads only its
+    shard, so input I/O scales with hosts. Pads by wrapping so every
+    host gets the same count (SPMD steps must agree on batch shape).
+    """
+    items = list(items)
+    count = count if count is not None else jax.process_count()
+    index = index if index is not None else jax.process_index()
+    if count <= 1:
+        return items
+    per = -(-len(items) // count)  # ceil
+    start = index * per
+    shard = items[start:start + per]
+    while len(shard) < per and items:
+        shard.append(items[(start + len(shard)) % len(items)])
+    return shard
+
+
+def global_batch(host_local: np.ndarray, mesh, axis: str = "data"):
+    """Assemble per-host arrays into ONE globally-sharded device array
+    (the infeed edge for multi-host training): each process contributes
+    its local rows; the result behaves as the full global batch under
+    ``jit`` with the mesh's data-axis sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(
+        mesh, P(axis, *([None] * (host_local.ndim - 1))))
+    if jax.process_count() == 1:
+        return jax.device_put(host_local, sharding)
+    global_shape = (host_local.shape[0] * jax.process_count(),
+                    *host_local.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, host_local, global_shape)

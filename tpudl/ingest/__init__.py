@@ -6,7 +6,14 @@ graph/input.py, graph/builder.py, graph/utils.py) — see
 :mod:`tpudl.ingest.graphdef` for the GraphDef→JAX translator.
 """
 
+from tpudl.ingest.builder import GraphFunction, IsolatedSession
 from tpudl.ingest.graphdef import UnsupportedOpError, build_jax_fn
 from tpudl.ingest.input import TFInputGraph
 
-__all__ = ["TFInputGraph", "build_jax_fn", "UnsupportedOpError"]
+__all__ = [
+    "TFInputGraph",
+    "GraphFunction",
+    "IsolatedSession",
+    "build_jax_fn",
+    "UnsupportedOpError",
+]

@@ -5,6 +5,8 @@ spark-deep-learning user finds every Transformer/Estimator under the
 name they know, running as fused XLA programs over the mesh.
 """
 
+from tpudl.ml.classification import (LogisticRegression,
+                                     LogisticRegressionModel)
 from tpudl.ml.estimator import KerasImageFileEstimator
 from tpudl.ml.keras_image import KerasImageFileTransformer
 from tpudl.ml.keras_tensor import KerasTransformer
@@ -23,6 +25,8 @@ __all__ = [
     "KerasTransformer",
     "KerasImageFileTransformer",
     "KerasImageFileEstimator",
+    "LogisticRegression",
+    "LogisticRegressionModel",
     "Transformer",
     "Estimator",
     "Model",

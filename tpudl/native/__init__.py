@@ -1,0 +1,122 @@
+"""Native input-pipeline bindings (ctypes over decode.cpp).
+
+Builds ``libtpudl_decode.so`` on first use with the system toolchain
+(g++ + libjpeg; no pip, no pybind11 — SURVEY.md §2.3's contract) and
+exposes :func:`decode_resize_batch`. Falls back cleanly: callers check
+:func:`available` and use the PIL path otherwise, so the framework works
+on hosts without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "decode_resize_batch", "build", "lib_path"]
+
+log = logging.getLogger("tpudl.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "decode.cpp")
+_LIB = os.path.join(_DIR, "libtpudl_decode.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def lib_path() -> str:
+    return _LIB
+
+
+def build(force: bool = False) -> bool:
+    """Compile decode.cpp → libtpudl_decode.so. Returns success."""
+    global _build_failed
+    if os.path.exists(_LIB) and not force:
+        return True
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-ljpeg", "-lpthread", "-o", _LIB]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable: %r", e)
+        _build_failed = True
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed:\n%s", proc.stderr[-2000:])
+        _build_failed = True
+        return False
+    return True
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.warning("native lib load failed: %r", e)
+            _build_failed = True
+            return None
+        lib.tpudl_decode_resize_batch.restype = ctypes.c_int
+        lib.tpudl_decode_resize_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+        ]
+        if lib.tpudl_native_abi_version() != 1:
+            log.warning("native ABI mismatch; rebuilding")
+            if not build(force=True):
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(_LIB)
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_resize_batch(blobs: list[bytes], height: int, width: int,
+                        n_threads: int | None = None
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """Decode a list of encoded JPEGs → ((N, H, W, 3) uint8 BGR batch,
+    ok mask). Failed rows are zeroed with ok=False (the reference's
+    null-row discipline, imageIO._decodeImage)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native decoder unavailable (no compiler or libjpeg); use the "
+            "PIL path (tpudl.image.imageIO)")
+    n = len(blobs)
+    out = np.zeros((n, height, width, 3), dtype=np.uint8)
+    status = np.zeros((n,), dtype=np.uint8)
+    if n == 0:
+        return out, status.astype(bool)
+    keepalive = [ctypes.create_string_buffer(b, len(b)) for b in blobs]
+    datas = (ctypes.c_char_p * n)(
+        *[ctypes.cast(buf, ctypes.c_char_p) for buf in keepalive])
+    sizes = (ctypes.c_size_t * n)(*[len(b) for b in blobs])
+    if n_threads is None:
+        n_threads = min(n, os.cpu_count() or 1)
+    lib.tpudl_decode_resize_batch(
+        datas, sizes, n, height, width,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        int(n_threads))
+    return out, status.astype(bool)
