@@ -1,0 +1,42 @@
+"""Driver-gate regression tests for __graft_entry__.
+
+Round-1 post-mortem: MULTICHIP_r01 went red because dryrun_multichip
+assumed the live backend already had n devices (the driver host has ONE
+real TPU chip). These tests pin both halves of the contract:
+
+- the inline path on the simulated 8-device CPU mesh (what the driver's
+  virtual-mesh run exercises), and
+- the self-provisioning subprocess path taken when fewer devices are
+  live than requested.
+"""
+
+import jax
+import numpy as np
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as g
+
+    fn, (params, example) = g.entry()
+    out = jax.jit(fn)(params, example)
+    out = np.asarray(jax.device_get(out))
+    assert out.shape == (example.shape[0], 2048)
+    assert np.isfinite(out).all()
+
+
+def test_dryrun_multichip_inline_8():
+    import __graft_entry__ as g
+
+    assert jax.device_count() >= 8  # conftest fakes the 8-device mesh
+    g.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_self_provisions():
+    """With fewer visible devices than requested the dryrun must re-exec
+    itself onto a virtual CPU mesh instead of dying with
+    'needs N devices, have 1' (the MULTICHIP_r01 failure)."""
+    import __graft_entry__ as g
+
+    # We can't shrink the live backend in-process, so drive the subprocess
+    # branch by asking for more devices than the suite's simulated 8.
+    g.dryrun_multichip(16)
