@@ -1,24 +1,34 @@
 #!/usr/bin/env python
-"""tpudl benchmark — the BASELINE.json headline config.
+"""tpudl benchmark — the BASELINE.json judged matrix.
 
-Measures ``DeepImageFeaturizer(InceptionV3).transform`` throughput
-(images/sec/chip) on the default jax backend (the real TPU chip under
-the driver; CPU elsewhere) and prints ONE JSON line:
+Headline: ``DeepImageFeaturizer(InceptionV3).transform`` throughput
+(images/sec/chip) — BASELINE.json configs[0] — plus the rest of the
+judged matrix as sub-benches:
 
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+- HorovodRunner ResNet50 train step/sec (configs[3], the other judged
+  number),
+- DeepImagePredictor ResNet50 batch inference (configs[1]),
+- KerasTransformer tabular-MLP rows/sec (configs[4]),
+- KerasImageFileEstimator time-to-fit (configs[2]).
+
+Prints ONE JSON line; the headline featurize number is metric/value and
+the sub-bench numbers ride in the same object.
 
 ``vs_baseline`` compares against the reference's execution substrate on
 this host — Keras/TF InceptionV3 inference on CPU (the reference
 publishes no numbers, BASELINE.md; we measure both sides ourselves).
-Set TPUDL_BENCH_SKIP_BASELINE=1 to skip the TF-CPU side (vs_baseline
-null), TPUDL_BENCH_N / _BATCH to resize the run.
 
-Everything except the final JSON line goes to stderr.
+Env knobs: TPUDL_BENCH_SKIP_BASELINE=1 skips the TF-CPU side;
+TPUDL_BENCH_QUICK=1 runs the headline config only; TPUDL_BENCH_N /
+_BATCH / _TRIALS resize the featurize run; TPUDL_BENCH_DTYPE picks the
+compute precision. Everything except the final JSON line goes to stderr.
 """
 
 import json
 import os
+import statistics
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -42,35 +52,186 @@ def make_frame(n, h=299, w=299, seed=0):
     return Frame({"image": structs})
 
 
-def measure_tpudl(n, batch):
-    import jax
-
+def measure_featurize(n, batch, dtype, trials=3):
+    """Headline: configs[0]. Median of ``trials`` timed transforms (the
+    link to a tunneled chip has high run-to-run variance; median is the
+    defensible point estimate, all trials are reported)."""
     from tpudl.ml import DeepImageFeaturizer
-    from tpudl.obs import Meter
 
-    devs = jax.devices()
-    log(f"backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind})")
-    dtype = os.environ.get("TPUDL_BENCH_DTYPE", "bfloat16")
-    log(f"compute dtype: {dtype} (standard TPU inference precision; "
-        "set TPUDL_BENCH_DTYPE=float32 for full-precision numbers)")
     feat = DeepImageFeaturizer(inputCol="image", outputCol="features",
                                modelName="InceptionV3", batchSize=batch,
                                computeDtype=dtype)
-    measure_tpudl.dtype = dtype  # surfaced in the JSON line
-    meter = Meter(n_chips=1, skip=1)  # batch 0 = compile+warmup
-    with meter.batch(batch):
-        feat.transform(make_frame(batch))
-    log(f"compile+warmup: {meter.report()['batches']} batch in "
-        f"{sum(t for _n, t in meter._batches):.1f}s")
+    t0 = time.perf_counter()
+    feat.transform(make_frame(batch))  # compile+warmup
+    warmup_s = time.perf_counter() - t0
+    log(f"compile+warmup: {warmup_s:.1f}s")
 
     frame = make_frame(n)
-    with meter.batch(n):
+    rates = []
+    for t in range(trials):
+        t0 = time.perf_counter()
         out = feat.transform(frame)
         np.asarray(out["features"][-1])  # materialized already; paranoia
-    r = meter.report()
-    log(f"tpudl featurize: {r['examples']} images in {r['seconds']}s -> "
-        f"{r['examples_per_sec_per_chip']} images/sec/chip")
-    return meter
+        dt = time.perf_counter() - t0
+        rates.append(n / dt)
+        log(f"featurize trial {t}: {n} images in {dt:.2f}s -> "
+            f"{rates[-1]:.1f} images/sec/chip")
+    value = statistics.median(rates)
+    log(f"featurize median of {trials}: {value:.1f} images/sec/chip")
+    return {"value": round(value, 2), "trials": [round(r, 1) for r in rates],
+            "warmup_seconds": round(warmup_s, 1)}
+
+
+def measure_train_step(dtype):
+    """configs[3]: HorovodRunner ResNet50 train step/sec on the live
+    backend (single chip here; the SPMD program is mesh-size-agnostic).
+    Fresh host batches every step — the transfer is part of the step,
+    as it is for the reference's NCCL path."""
+    import jax
+
+    from tpudl.train import HorovodRunner
+
+    batch = int(os.environ.get("TPUDL_BENCH_TRAIN_BATCH", "64"))
+    steps = int(os.environ.get("TPUDL_BENCH_TRAIN_STEPS", "10"))
+    rng = np.random.default_rng(0)
+    # uint8 images, normalized on device — the TPU-native input pipeline
+    # (4x fewer host->device bytes than feeding pre-normalized float32)
+    xs = [rng.integers(0, 256, size=(batch, 224, 224, 3), dtype=np.uint8)
+          for _ in range(4)]
+    ys = [np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)] for _ in range(4)]
+
+    def train_fn(ctx):
+        import jax.numpy as jnp
+        import optax
+
+        from tpudl.zoo.registry import getKerasApplicationModel
+
+        from tpudl.zoo.registry import cast_params
+
+        model = getKerasApplicationModel("ResNet50")
+        params = model.init(0)
+        if dtype != "float32":
+            params = cast_params(params, dtype)
+
+        def loss_fn(p, x, y):
+            x = (x.astype(jnp.dtype(dtype)) - 127.5) / 127.5
+            logits = model.predict(p, x)
+            logp = jnp.log(jnp.clip(logits, 1e-7, 1.0))
+            return -jnp.mean(jnp.sum(y * logp, axis=-1))
+
+        trainer = ctx.trainer(loss_fn, optax.sgd(0.05))
+        data = lambda step: (xs[step % len(xs)], ys[step % len(ys)])
+        trainer.fit(params, data, steps=1)  # compile + warm step
+        t0 = time.perf_counter()
+        trainer.fit(params, data, steps=steps)
+        dt = time.perf_counter() - t0
+        return steps / dt, batch * steps / dt
+
+    sps, ips = HorovodRunner(np=1).run(train_fn)
+    log(f"HorovodRunner ResNet50: {sps:.2f} steps/sec "
+        f"({ips:.1f} images/sec, batch {batch})")
+    return {"step_per_sec": round(sps, 3), "images_per_sec": round(ips, 1),
+            "batch_size": batch}
+
+
+def measure_predictor(dtype):
+    """configs[1]: DeepImagePredictor ResNet50 batch inference."""
+    from tpudl.ml import DeepImagePredictor
+
+    n = int(os.environ.get("TPUDL_BENCH_PRED_N", "512"))
+    n = max(256, n - n % 256)  # whole batches: a ragged tail would compile
+    pred = DeepImagePredictor(inputCol="image", outputCol="preds",
+                              modelName="ResNet50", batchSize=256,
+                              computeDtype=dtype)
+    frame = make_frame(n, h=224, w=224)
+    pred.transform(frame.head(256))  # compile+warmup
+    t0 = time.perf_counter()
+    pred.transform(frame)
+    dt = time.perf_counter() - t0
+    ips = n / dt
+    log(f"DeepImagePredictor ResNet50: {n} images in {dt:.2f}s -> "
+        f"{ips:.1f} images/sec/chip")
+    return {"images_per_sec": round(ips, 1)}
+
+
+def measure_keras_transformer():
+    """configs[4]: KerasTransformer over a tabular array column."""
+    import keras
+
+    from tpudl.frame import Frame
+    from tpudl.ml import KerasTransformer
+
+    rows = int(os.environ.get("TPUDL_BENCH_MLP_ROWS", "65536"))
+    dim = 100
+    keras.utils.set_random_seed(0)
+    m = keras.Sequential([
+        keras.layers.Input((dim,)),
+        keras.layers.Dense(256, activation="relu"),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "mlp.keras")
+        m.save(path)
+        kt = KerasTransformer(inputCol="x", outputCol="y", modelFile=path,
+                              batchSize=8192)
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(rows, dim)).astype(np.float32)
+        frame = Frame({"x": data})
+        kt.transform(Frame({"x": data[:8192]}))  # compile+warmup
+        t0 = time.perf_counter()
+        kt.transform(frame)
+        dt = time.perf_counter() - t0
+    rps = rows / dt
+    log(f"KerasTransformer MLP: {rows} rows in {dt:.2f}s -> {rps:.0f} rows/sec")
+    return {"rows_per_sec": round(rps, 1)}
+
+
+def measure_estimator_fit():
+    """configs[2]: KerasImageFileEstimator time-to-fit (transfer-learning
+    loop: ingest keras model -> train over image files -> transformer)."""
+    import keras
+    from PIL import Image
+
+    from tpudl.frame import Frame
+    from tpudl.ml import KerasImageFileEstimator
+
+    n_files = 32
+    keras.utils.set_random_seed(0)
+    m = keras.Sequential([
+        keras.layers.Input((32, 32, 3)),
+        keras.layers.Conv2D(8, 3, activation="relu"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+
+    def loader(uri):
+        img = Image.open(uri).convert("RGB").resize((32, 32), Image.BILINEAR)
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+    with tempfile.TemporaryDirectory() as d:
+        rng = np.random.default_rng(0)
+        uris, labels = [], []
+        for i in range(n_files):
+            arr = rng.integers(0, 255, size=(48, 48, 3), dtype=np.uint8)
+            p = os.path.join(d, f"im{i}.png")
+            Image.fromarray(arr).save(p)
+            uris.append(p)
+            labels.append(np.eye(2, dtype=np.float32)[i % 2])
+        path = os.path.join(d, "cnn.keras")
+        m.save(path)
+        est = KerasImageFileEstimator(
+            inputCol="uri", outputCol="out", labelCol="label",
+            imageLoader=loader, modelFile=path,
+            kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
+            kerasFitParams={"epochs": 2, "batch_size": 16})
+        frame = Frame({"uri": uris, "label": labels})
+        t0 = time.perf_counter()
+        model = est.fit(frame)
+        dt = time.perf_counter() - t0
+    log(f"KerasImageFileEstimator: fit {n_files} files x 2 epochs in {dt:.2f}s")
+    return {"fit_seconds": round(dt, 2)}
 
 
 def measure_tf_cpu_baseline(k=64, batch=32):
@@ -96,11 +257,46 @@ def measure_tf_cpu_baseline(k=64, batch=32):
     return ips
 
 
+# InceptionV3 forward ≈ 6 GFLOPs/image; TPU v5e peak ≈ 197 bf16 TFLOP/s.
+_INCEPTION_FLOPS = 6e9
+_V5E_PEAK_FLOPS = 197e12
+
+
 def main():
-    batch = int(os.environ.get("TPUDL_BENCH_BATCH", "64"))
-    n = int(os.environ.get("TPUDL_BENCH_N", "512"))
+    import jax
+
+    devs = jax.devices()
+    log(f"backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind})")
+    dtype = os.environ.get("TPUDL_BENCH_DTYPE", "bfloat16")
+    log(f"compute dtype: {dtype} (standard TPU inference precision; "
+        "set TPUDL_BENCH_DTYPE=float32 for full-precision numbers)")
+    batch = int(os.environ.get("TPUDL_BENCH_BATCH", "256"))
+    n = int(os.environ.get("TPUDL_BENCH_N", "1024"))
     n = max(batch, n - n % batch)  # whole batches, at least one
-    meter = measure_tpudl(n, batch)
+    trials = int(os.environ.get("TPUDL_BENCH_TRIALS", "3"))
+
+    feat = measure_featurize(n, batch, dtype, trials)
+    extra = {
+        "compute_dtype": dtype,
+        "batch_size": batch,
+        "featurize_trials": feat["trials"],
+        "compile_warmup_seconds": feat["warmup_seconds"],
+        "baseline": "keras InceptionV3 on TF-CPU (fp32), this host",
+    }
+    if devs[0].platform == "tpu":  # peak constant is the v5e figure
+        extra["mfu_end_to_end"] = round(
+            feat["value"] * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS, 5)
+
+    if os.environ.get("TPUDL_BENCH_QUICK", "0") != "1":
+        for key, fn in [("horovod_resnet50", lambda: measure_train_step(dtype)),
+                        ("predictor_resnet50", lambda: measure_predictor(dtype)),
+                        ("keras_transformer_mlp", measure_keras_transformer),
+                        ("estimator", measure_estimator_fit)]:
+            try:
+                extra[key] = fn()
+            except Exception as e:  # sub-bench failure must not kill the bench
+                log(f"sub-bench {key} failed: {e!r}")
+                extra[key] = {"error": repr(e)}
 
     base = None
     if os.environ.get("TPUDL_BENCH_SKIP_BASELINE", "0") != "1":
@@ -109,12 +305,14 @@ def main():
         except Exception as e:  # baseline failure must not kill the bench
             log(f"baseline measurement failed: {e!r}")
 
-    print(meter.json_line(
-        "images/sec/chip (DeepImageFeaturizer InceptionV3)", baseline=base,
-        extra={"compute_dtype": getattr(measure_tpudl, "dtype", "float32"),
-               "batch_size": batch,
-               "baseline": "keras InceptionV3 on TF-CPU (fp32), this host"}),
-        flush=True)
+    out = {
+        "metric": "images/sec/chip (DeepImageFeaturizer InceptionV3)",
+        "value": feat["value"],
+        "unit": "images/sec/chip",
+        "vs_baseline": round(feat["value"] / base, 3) if base else None,
+    }
+    out.update(extra)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

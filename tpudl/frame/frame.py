@@ -166,7 +166,11 @@ class Frame:
         if missing:
             raise KeyError(f"unknown input columns {missing}")
         outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
+        acc: list[list] = [[] for _ in output_cols]  # device-resident results
+        segs: list[tuple[int, int]] = []  # (padded_len, n_pad) per batch
         pending: list[tuple[tuple, int]] = []
+        mode = None  # "acc" (fetch once at end) or "window" (bounded drain)
+        est_batches = max(1, -(-self._n // max(1, batch_size)))
         for start, stop in self.iter_batches(batch_size):
             packed = []
             for c in input_cols:
@@ -179,6 +183,9 @@ class Frame:
                 padded = [M.pad_batch(arr, multiple) for arr in packed]
                 n_pad = padded[0][1] if padded else 0
                 packed = [M.shard_batch(p, mesh) for p, _ in padded]
+            # (mesh=None: host arrays go straight into the jitted fn — the
+            # runtime's own arg transfer pipelines far better than an
+            # explicit device_put through tunneled backends)
             result = fn(*packed)
             if not isinstance(result, (tuple, list)):
                 result = (result,)
@@ -186,15 +193,30 @@ class Frame:
                 raise ValueError(
                     f"fn returned {len(result)} outputs, expected {len(output_cols)}"
                 )
-            # pipeline window: dispatch is async, so deferring the host
-            # copy lets batch k's compute overlap batch k+1's host pack
-            # (SURVEY.md §3.2); the window is bounded so device memory
-            # stays O(window · batch), not O(rows).
-            pending.append((tuple(result), n_pad))
-            if len(pending) > _PIPELINE_WINDOW:
-                _drain(pending.pop(0), outputs)
+            if mode is None:
+                mode = _pick_fetch_mode(result, est_batches)
+            if mode == "acc":
+                # Keep results device-resident and fetch ONCE per column at
+                # the end: device→host fetch has a large fixed cost per
+                # round-trip on tunneled/remote PJRT backends, so per-batch
+                # fetching serializes the pipeline (round-1 bottleneck).
+                for i, r in enumerate(result):
+                    acc[i].append(r)
+                segs.append((stop - start + n_pad, n_pad))
+            else:
+                # Large outputs (e.g. outputMode='image'): bounded window so
+                # device memory stays O(window · batch), with the host copy
+                # started at dispatch so it overlaps later batches' compute.
+                for r in result:
+                    if hasattr(r, "copy_to_host_async"):
+                        r.copy_to_host_async()
+                pending.append((tuple(result), n_pad))
+                if len(pending) > _PIPELINE_WINDOW:
+                    _drain(pending.pop(0), outputs)
         while pending:
             _drain(pending.pop(0), outputs)
+        if mode == "acc":
+            _fetch_accumulated(acc, segs, outputs)
         out = self
         for name, chunks in zip(output_cols, outputs):
             col = np.concatenate(chunks, axis=0) if chunks else np.empty((0,))
@@ -207,6 +229,36 @@ class Frame:
 
 
 _PIPELINE_WINDOW = 2  # in-flight device batches retained before fetch
+_ACC_FETCH_CAP = 512 * 1024 * 1024  # max bytes held on device in "acc" mode
+
+
+def _pick_fetch_mode(result, est_batches: int) -> str:
+    """Device-resident accumulation for small outputs (features, scores),
+    windowed drain for big ones (image-sized tensors) or host results."""
+    if not all(hasattr(r, "copy_to_host_async") for r in result):
+        return "window"  # fn returned host arrays; drain is free
+    per_batch = sum(r.nbytes for r in result)
+    return "acc" if per_batch * est_batches <= _ACC_FETCH_CAP else "window"
+
+
+def _fetch_accumulated(acc, segs, outputs):
+    """Concatenate per-column device results and fetch each ONCE; strip
+    per-batch mesh padding host-side."""
+    import jax.numpy as jnp
+
+    for i, chunks in enumerate(acc):
+        if not chunks:
+            continue
+        cat = jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
+        host = np.asarray(cat)
+        if any(n_pad for _, n_pad in segs):
+            parts, pos = [], 0
+            for padded_len, n_pad in segs:
+                parts.append(host[pos: pos + padded_len - n_pad])
+                pos += padded_len
+            outputs[i].extend(parts)
+        else:
+            outputs[i].append(host)
 
 
 def _drain(entry, outputs):

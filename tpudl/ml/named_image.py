@@ -49,7 +49,10 @@ def load_named_params(model_name: str, weights: str = "random") -> dict:
         return _PARAMS_CACHE[key]
     model = getKerasApplicationModel(model_name)
     if weights == "random":
-        params = model.init(jax.random.key(0))
+        # host fast path: numpy init, zero device dispatches (the round-1
+        # bench spent ~25s here dispatching per-layer init kernels through
+        # the device tunnel)
+        params = model.init(0)
     elif weights == "imagenet":
         from tpudl.zoo.convert import params_from_keras
 
@@ -105,12 +108,18 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
             params = load_named_params(name, self.weights)
             if dtype != "float32":
                 # MXU-native precision: bf16 params+activations, fp32 in
-                # the decode/preprocess prologue and the output epilogue
-                params = jax.tree.map(
-                    lambda p: p.astype(dtype)
-                    if jnp.issubdtype(np.asarray(p).dtype if not hasattr(
-                        p, "dtype") else p.dtype, jnp.floating)
-                    else p, params)
+                # the decode/preprocess prologue and the output epilogue.
+                from tpudl.zoo.registry import cast_params
+
+                params = cast_params(params, dtype)
+            # one transfer for the whole tree, replicated over the mesh if
+            # one is set (the Spark-broadcast analogue)
+            if self.mesh is not None:
+                from tpudl import mesh as M
+
+                params = M.replicate(params, self.mesh)
+            else:
+                params = jax.device_put(params)
             h, w = model.input_size
             head = self._head_fn(model, params)
 

@@ -136,6 +136,10 @@ class Trainer:
         self.save_every = save_every
         self.log_every = log_every
         self.history: list[dict] = []
+        # one compiled SPMD program per Trainer: rebuilding the jit wrapper
+        # per fit() would retrace+recompile every call (loss_fn/optimizer/
+        # mesh are fixed at construction, so the program is too)
+        self._step_fn = make_train_step(loss_fn, optimizer, mesh)
 
     def fit(self, params, data_fn, steps: int, *, opt_state=None):
         """Train for ``steps`` total steps (resuming included). Returns
@@ -157,7 +161,7 @@ class Trainer:
                 start = int(restored["step"])
                 log.info("resumed from checkpoint at step %d", start)
 
-        step_fn = make_train_step(self.loss_fn, self.optimizer, self.mesh)
+        step_fn = self._step_fn
         # own the buffers: the step donates params/opt_state, and device_put
         # may alias the caller's arrays — donating an alias would delete the
         # caller's data out from under them. Host-side copy is placement-
@@ -168,6 +172,11 @@ class Trainer:
             params = M.replicate(params, self.mesh)
             opt_state = M.replicate(opt_state, self.mesh)
 
+        # A 1-wide data axis needs no explicit sharding: host arrays go
+        # straight into the jitted step, whose own arg transfer pipelines
+        # (an explicit per-step device_put serializes on tunneled backends).
+        shard_inputs = (self.mesh is not None
+                        and self.mesh.shape[M.DATA_AXIS] > 1)
         t0 = time.perf_counter()
         examples = 0
         loss = None
@@ -176,7 +185,7 @@ class Trainer:
                 batch = data_fn(step)
                 if not isinstance(batch, tuple):
                     batch = (batch,)
-                if self.mesh is not None:
+                if shard_inputs:
                     batch = tuple(M.shard_batch(b, self.mesh) for b in batch)
                 params, opt_state, loss = step_fn(params, opt_state, *batch)
                 examples += int(np.shape(batch[0])[0])
