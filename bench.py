@@ -234,6 +234,46 @@ def measure_estimator_fit():
     return {"fit_seconds": round(dt, 2)}
 
 
+def measure_decode():
+    """Input-pipeline decode stage (the reference's historic bottleneck,
+    SURVEY.md §3.1): native threaded libjpeg batch decode+resize vs the
+    PIL loop, on ~VGA JPEGs resized to 299×299."""
+    import io
+
+    from PIL import Image
+
+    from tpudl import native
+
+    k = int(os.environ.get("TPUDL_BENCH_DECODE_N", "256"))
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 256, size=(60, 80, 3), dtype=np.uint8)
+    photo = np.asarray(Image.fromarray(base).resize((800, 600),
+                                                    Image.BILINEAR))
+    raws = []
+    for q in range(k):
+        buf = io.BytesIO()
+        Image.fromarray(photo).save(buf, "JPEG", quality=80 + q % 15)
+        raws.append(buf.getvalue())
+
+    t0 = time.perf_counter()
+    for raw in raws:
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        np.asarray(img.resize((299, 299), Image.BILINEAR))
+    pil_ips = k / (time.perf_counter() - t0)
+
+    out = {"pil_images_per_sec": round(pil_ips, 1)}
+    if native.available():
+        native.decode_resize_batch(raws[:8], 299, 299)  # warm build/load
+        t0 = time.perf_counter()
+        _batch, ok = native.decode_resize_batch(raws, 299, 299)
+        nat_ips = k / (time.perf_counter() - t0)
+        assert all(ok)
+        out["native_images_per_sec"] = round(nat_ips, 1)
+        out["native_speedup"] = round(nat_ips / pil_ips, 2)
+    log(f"decode 800x600 JPEG -> 299x299: {out}")
+    return out
+
+
 def measure_tf_cpu_baseline(k=64, batch=32):
     """The reference path's substrate: Keras InceptionV3 (no top, avg
     pool) on TF-CPU — what sparkdl's executors ran when no GPU was
@@ -291,7 +331,8 @@ def main():
         for key, fn in [("horovod_resnet50", lambda: measure_train_step(dtype)),
                         ("predictor_resnet50", lambda: measure_predictor(dtype)),
                         ("keras_transformer_mlp", measure_keras_transformer),
-                        ("estimator", measure_estimator_fit)]:
+                        ("estimator", measure_estimator_fit),
+                        ("decode", measure_decode)]:
             try:
                 extra[key] = fn()
             except Exception as e:  # sub-bench failure must not kill the bench

@@ -1,0 +1,206 @@
+"""Task-parallel HPO tests — the rebuild of the reference's trial
+parallelism contract (ref: keras_image_file_estimator.py _fitInParallel
+~L250: one concurrent Spark task per paramMap). Round-1 verdict item:
+fitMultiple ran trials strictly sequentially; these tests pin (a) real
+concurrency (≥2 trials in flight), (b) completion-order yields, and
+(c) device-slice assignment."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tpudl.ml.hpo import TrialScheduler, device_slices
+
+
+class TestDeviceSlices:
+    def test_fewer_trials_widen_slices(self):
+        devs = jax.devices()
+        slices = device_slices(2, devs)
+        assert len(slices) == 2
+        assert all(len(s) == len(devs) // 2 for s in slices)
+        flat = [d for s in slices for d in s]
+        assert len(set(flat)) == len(flat)  # disjoint
+
+    def test_more_trials_than_devices(self):
+        devs = jax.devices()
+        slices = device_slices(100, devs)
+        assert len(slices) == len(devs)
+        assert all(len(s) == 1 for s in slices)
+
+    def test_single_device_pool(self):
+        slices = device_slices(4, jax.devices()[:1])
+        assert len(slices) == 1
+
+
+class TestTrialScheduler:
+    def test_trials_actually_overlap(self):
+        lock = threading.Lock()
+        inflight = 0
+        max_inflight = 0
+
+        def trial(i, item, devs):
+            nonlocal inflight, max_inflight
+            with lock:
+                inflight += 1
+                max_inflight = max(max_inflight, inflight)
+            time.sleep(0.15)
+            with lock:
+                inflight -= 1
+            return item * 10
+
+        out = dict(TrialScheduler().run(range(4), trial))
+        assert out == {0: 0, 1: 10, 2: 20, 3: 30}
+        assert max_inflight >= 2, (
+            f"only {max_inflight} trial ever in flight — scheduling is "
+            "sequential, the round-1 regression")
+
+    def test_completion_order_not_submission_order(self):
+        def trial(i, item, devs):
+            time.sleep(0.4 if i == 0 else 0.05)
+            return i
+
+        order = [i for i, _r in TrialScheduler().run(range(3), trial)]
+        assert order[-1] == 0, f"slow trial 0 must finish last, got {order}"
+
+    def test_each_trial_gets_disjoint_slice(self):
+        seen = {}
+        lock = threading.Lock()
+
+        def trial(i, item, devs):
+            with lock:
+                seen[i] = tuple(devs)
+            time.sleep(0.1)  # hold the slice so assignments can't reuse
+            return i
+
+        n = min(4, jax.device_count())
+        dict(TrialScheduler().run(range(n), trial))
+        concurrent_slices = list(seen.values())
+        flat = [d for s in concurrent_slices for d in s]
+        assert len(set(flat)) == len(flat), "slices overlap"
+
+    def test_trial_exception_propagates(self):
+        def trial(i, item, devs):
+            if i == 1:
+                raise RuntimeError("boom")
+            return i
+
+        with pytest.raises(RuntimeError, match="boom"):
+            dict(TrialScheduler().run(range(2), trial))
+
+    def test_empty_items(self):
+        assert list(TrialScheduler().run([], lambda *a: None)) == []
+
+    def test_max_parallel_cap(self):
+        lock = threading.Lock()
+        inflight = 0
+        max_inflight = 0
+
+        def trial(i, item, devs):
+            nonlocal inflight, max_inflight
+            with lock:
+                inflight += 1
+                max_inflight = max(max_inflight, inflight)
+            time.sleep(0.1)
+            with lock:
+                inflight -= 1
+            return i
+
+        dict(TrialScheduler(max_parallel=1).run(range(3), trial))
+        assert max_inflight == 1
+
+
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture(scope="module")
+def tiny_sets(tmp_path_factory):
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("hpo_imgs")
+    rng = np.random.default_rng(0)
+    uris, labels = [], []
+    for i in range(8):
+        arr = rng.integers(0, 255, size=(12, 12, 3), dtype=np.uint8)
+        p = str(d / f"im{i}.png")
+        Image.fromarray(arr).save(p)
+        uris.append(p)
+        labels.append(np.eye(2, dtype=np.float32)[i % 2])
+    keras.utils.set_random_seed(0)
+    m = keras.Sequential([
+        keras.layers.Input((10, 10, 3)),
+        keras.layers.Conv2D(3, 3, activation="relu"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    path = str(tmp_path_factory.mktemp("hpo_model") / "m.keras")
+    m.save(path)
+    return uris, labels, path
+
+
+def _loader(uri):
+    from PIL import Image
+
+    img = Image.open(uri).convert("RGB").resize((10, 10), Image.BILINEAR)
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+class TestEstimatorParallelHPO:
+    def _est(self, model_path):
+        from tpudl.ml import KerasImageFileEstimator
+
+        return KerasImageFileEstimator(
+            inputCol="uri", outputCol="pred", labelCol="label",
+            imageLoader=_loader, modelFile=model_path,
+            kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
+            kerasFitParams={"batch_size": 4, "epochs": 2})
+
+    def test_fit_multiple_runs_trials_concurrently(self, tiny_sets):
+        from tpudl.frame import Frame
+
+        uris, labels, model_path = tiny_sets
+        est = self._est(model_path)
+        frame = Frame({"uri": uris, "label": labels})
+
+        lock = threading.Lock()
+        inflight = 0
+        max_inflight = 0
+        orig = est._train_one
+
+        def spy(*a, **kw):
+            nonlocal inflight, max_inflight
+            with lock:
+                inflight += 1
+                max_inflight = max(max_inflight, inflight)
+            try:
+                time.sleep(0.05)  # widen the overlap window
+                return orig(*a, **kw)
+            finally:
+                with lock:
+                    inflight -= 1
+
+        est._train_one = spy
+        pms = [{est.kerasFitParams: {"batch_size": 4, "epochs": 2,
+                                     "learning_rate": lr}}
+               for lr in (1e-2, 3e-3, 1e-3, 3e-4)]
+        got = dict(est.fitMultiple(frame, pms))
+        assert sorted(got) == [0, 1, 2, 3]
+        for m in got.values():
+            preds = np.stack(list(m.transform(frame)["pred"]))
+            assert preds.shape == (8, 2)
+            assert np.isfinite(preds).all()
+        assert max_inflight >= 2, (
+            f"only {max_inflight} trial in flight — fitMultiple is still "
+            "sequential")
+
+    def test_equal_valued_override_stays_on_shared_path(self, tiny_sets):
+        """ADVICE round 1: identity comparison sent equal-valued overrides
+        down the expensive private-_fit path."""
+        uris, labels, model_path = tiny_sets
+        est = self._est(model_path)
+        conf = est.copy({est.modelFile: model_path})  # equal value
+        assert not est._overrides_shared(conf)
+        conf2 = est.copy({est.modelFile: "/somewhere/else.keras"})
+        assert est._overrides_shared(conf2)

@@ -1,0 +1,196 @@
+"""First-party native decoder tests — the SURVEY.md §2.3 native-contract
+component (threaded libjpeg decode+resize+pack) wired into the input hot
+path, PIL-oracle checked (ref test pattern: golden decode/resize tests,
+imageIO._decodeImage null-row discipline)."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tpudl import native
+from tpudl.image import imageIO
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason="native decoder unavailable (no compiler/libjpeg)")
+
+
+def _jpeg_bytes(arr: np.ndarray, quality=95) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def photo():
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 256, size=(40, 40, 3), dtype=np.uint8)
+    return np.asarray(Image.fromarray(base).resize((400, 300),
+                                                   Image.BILINEAR))
+
+
+class TestDecodeBatch:
+    def test_full_size_bit_exact_vs_pil(self, photo):
+        raw = _jpeg_bytes(photo)
+        pil = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"))
+        batch, ok = native.decode_resize_batch([raw], 300, 400)
+        assert ok[0]
+        assert np.array_equal(batch[0][:, :, ::-1], pil)  # BGR storage
+
+    def test_resize_close_to_pil(self, photo):
+        raw = _jpeg_bytes(photo)
+        pil = np.asarray(
+            Image.open(io.BytesIO(raw)).convert("RGB").resize(
+                (160, 120), Image.BILINEAR), dtype=np.int16)
+        batch, ok = native.decode_resize_batch([raw], 120, 160)
+        assert ok[0]
+        diff = np.abs(batch[0][:, :, ::-1].astype(np.int16) - pil)
+        # DCT-domain downscale + a different bilinear: same semantics,
+        # not bit-exact (decode.cpp header comment)
+        assert diff.mean() < 4.0 and diff.max() < 48, (
+            diff.mean(), diff.max())
+
+    def test_corrupt_rows_zeroed_not_raised(self, photo):
+        raw = _jpeg_bytes(photo)
+        batch, ok = native.decode_resize_batch(
+            [raw, b"not a jpeg", raw[: len(raw) // 2]], 64, 64)
+        assert list(ok) == [True, False, False]
+        assert batch[1].sum() == 0 and batch[2].sum() == 0
+        assert batch[0].sum() > 0
+
+    def test_grayscale_widens_to_3ch(self):
+        g = np.linspace(0, 255, 64 * 64).reshape(64, 64).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(g, mode="L").save(buf, "JPEG", quality=95)
+        batch, ok = native.decode_resize_batch([buf.getvalue()], 64, 64)
+        assert ok[0]
+        b = batch[0]
+        assert np.array_equal(b[:, :, 0], b[:, :, 1])
+        assert np.array_equal(b[:, :, 1], b[:, :, 2])
+
+    def test_empty_batch(self):
+        batch, ok = native.decode_resize_batch([], 32, 32)
+        assert batch.shape == (0, 32, 32, 3) and len(ok) == 0
+
+    def test_many_threads_deterministic(self, photo):
+        raws = [_jpeg_bytes(photo, quality=q) for q in (70, 80, 90, 95)] * 4
+        one, ok1 = native.decode_resize_batch(raws, 96, 96, n_threads=1)
+        many, okm = native.decode_resize_batch(raws, 96, 96, n_threads=8)
+        assert np.array_equal(one, many) and list(ok1) == list(okm)
+
+
+class TestJpegDims:
+    def test_dims_from_header(self, photo):
+        assert imageIO._jpeg_dims(_jpeg_bytes(photo)) == (300, 400)
+
+    def test_non_jpeg_returns_none(self, photo):
+        buf = io.BytesIO()
+        Image.fromarray(photo).save(buf, "PNG")
+        assert imageIO._jpeg_dims(buf.getvalue()) is None
+        assert imageIO._jpeg_dims(b"") is None
+        assert imageIO._jpeg_dims(b"\xff\xd8\xff") is None
+
+
+class TestReadImagesNativePath:
+    def test_read_images_matches_pil_decoder(self, photo, tmp_path):
+        (tmp_path / "a.jpg").write_bytes(_jpeg_bytes(photo))
+        Image.fromarray(photo).save(tmp_path / "b.png")
+        (tmp_path / "c.jpg").write_bytes(b"corrupt garbage")
+        frame = imageIO.readImages(str(tmp_path))
+        ref = imageIO.readImagesWithCustomFn(str(tmp_path),
+                                             imageIO.PIL_decode)
+        assert len(frame) == 3
+        for got, want in zip(frame["image"], ref["image"]):
+            if want is None:
+                assert got is None
+                continue
+            assert got["height"] == want["height"]
+            assert got["mode"] == want["mode"]
+            # JPEG full-size decode is bit-exact; PNG goes through PIL
+            assert got["data"] == want["data"]
+
+    def test_default_decode_falls_back_for_png(self, photo):
+        buf = io.BytesIO()
+        Image.fromarray(photo).save(buf, "PNG")
+        s = imageIO.default_decode(buf.getvalue(), origin="x.png")
+        assert s is not None and s["height"] == 300
+
+    def test_default_decode_corrupt_returns_none(self):
+        assert imageIO.default_decode(b"junk") is None
+
+
+class TestNativeImageLoader:
+    def test_loader_matches_pil_loader(self, photo, tmp_path):
+        p = str(tmp_path / "x.jpg")
+        (tmp_path / "x.jpg").write_bytes(_jpeg_bytes(photo))
+        loader = imageIO.createNativeImageLoader(64, 64, scale=1 / 255.0)
+        one = loader(p)
+        assert one.shape == (64, 64, 3) and one.dtype == np.float32
+        pil = np.asarray(
+            Image.open(p).convert("RGB").resize((64, 64), Image.BILINEAR),
+            dtype=np.float32) / 255.0
+        assert np.abs(one - pil).mean() < 0.02
+
+    def test_batch_decode_used_by_load_uri_batch(self, photo, tmp_path):
+        from tpudl.ml.image_params import load_uri_batch
+
+        uris = []
+        for i in range(6):
+            p = tmp_path / f"{i}.jpg"
+            p.write_bytes(_jpeg_bytes(photo, quality=80 + i))
+            uris.append(str(p))
+        loader = imageIO.createNativeImageLoader(48, 48)
+        batch = load_uri_batch(loader, np.array(uris, dtype=object))
+        assert batch.shape == (6, 48, 48, 3)
+        singles = np.stack([loader(u) for u in uris])
+        assert np.array_equal(batch, singles)
+
+    def test_batch_decode_falls_back_per_bad_file(self, photo, tmp_path):
+        good = tmp_path / "g.jpg"
+        good.write_bytes(_jpeg_bytes(photo))
+        png = tmp_path / "p.png"  # not JPEG: native fails, PIL succeeds
+        Image.fromarray(photo).save(png)
+        loader = imageIO.createNativeImageLoader(32, 32)
+        batch = loader.batch_decode([str(good), str(png)])
+        assert batch.shape == (2, 32, 32, 3)
+        assert batch[1].sum() > 0  # PIL fallback filled the row
+
+    def test_transformer_pack_stage_end_to_end(self, photo, tmp_path):
+        """KerasImageFileTransformer with the native loader == with a PIL
+        loader (the VERDICT wire-in requirement)."""
+        keras = pytest.importorskip("keras")
+        from tpudl.frame import Frame
+        from tpudl.ml import KerasImageFileTransformer
+
+        uris = []
+        for i in range(5):
+            p = tmp_path / f"{i}.jpg"
+            p.write_bytes(_jpeg_bytes(photo, quality=90))
+            uris.append(str(p))
+        keras.utils.set_random_seed(0)
+        m = keras.Sequential([
+            keras.layers.Input((24, 24, 3)),
+            keras.layers.Conv2D(2, 3),
+            keras.layers.GlobalAveragePooling2D(),
+        ])
+        mp = str(tmp_path / "m.keras")
+        m.save(mp)
+
+        def pil_loader(uri):
+            img = Image.open(uri).convert("RGB").resize(
+                (24, 24), Image.BILINEAR)
+            return np.asarray(img, np.float32) / 255.0
+
+        frame = Frame({"uri": np.array(uris, dtype=object)})
+        nat = KerasImageFileTransformer(
+            inputCol="uri", outputCol="f", modelFile=mp,
+            imageLoader=imageIO.createNativeImageLoader(24, 24, 1 / 255.0))
+        pil = KerasImageFileTransformer(
+            inputCol="uri", outputCol="f", modelFile=mp,
+            imageLoader=pil_loader)
+        a = np.stack(list(nat.transform(frame)["f"]))
+        b = np.stack(list(pil.transform(frame)["f"]))
+        # decode+resize differ slightly (DCT downscale); features track
+        assert np.abs(a - b).max() < 0.05, np.abs(a - b).max()

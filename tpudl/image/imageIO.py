@@ -196,6 +196,106 @@ def PIL_decode_and_resize(
     return imageArrayToStruct(rgb[:, :, ::-1], origin=origin)
 
 
+def _jpeg_dims(raw: bytes) -> tuple[int, int] | None:
+    """(height, width) from a JPEG header via a pure-python SOF-marker
+    scan (no decode), or None when the bytes aren't a JPEG. Lets the
+    full-size native decode route reuse the fixed-geometry batch API."""
+    if len(raw) < 4 or raw[0:2] != b"\xff\xd8":
+        return None
+    i, n = 2, len(raw)
+    while i + 9 < n:
+        if raw[i] != 0xFF:
+            i += 1
+            continue
+        marker = raw[i + 1]
+        if marker == 0xFF:  # 0xFF fill/padding byte before a marker
+            i += 1
+            continue
+        if marker in (0xD8, 0x01) or 0xD0 <= marker <= 0xD7:
+            i += 2  # parameterless markers
+            continue
+        if marker == 0xDA:  # start-of-scan reached without a SOF
+            return None
+        seg_len = int.from_bytes(raw[i + 2:i + 4], "big")
+        if 0xC0 <= marker <= 0xCF and marker not in (0xC4, 0xC8, 0xCC):
+            h = int.from_bytes(raw[i + 5:i + 7], "big")
+            w = int.from_bytes(raw[i + 7:i + 9], "big")
+            return (h, w) if h > 0 and w > 0 else None
+        i += 2 + seg_len
+    return None
+
+
+def default_decode(raw_bytes: bytes, origin: str = "") -> dict | None:
+    """Decode bytes → image struct: threaded-C libjpeg for JPEGs when
+    ``tpudl.native`` is available (bit-exact with PIL at full size — both
+    are libjpeg), PIL for every other format or as fallback. This is the
+    reference's executor decode stage with the first-party native decoder
+    on the hot path (SURVEY.md §2.3 native contract, §7.3)."""
+    from tpudl import native
+
+    if native.available():
+        dims = _jpeg_dims(raw_bytes)
+        # Decompression-bomb guard (PIL's MAX_IMAGE_PIXELS discipline):
+        # headers claiming huge geometry go to PIL, whose bomb check
+        # yields the null row instead of a multi-GB allocation.
+        if dims is not None and dims[0] * dims[1] <= 64_000_000:
+            batch, ok = native.decode_resize_batch(
+                [raw_bytes], dims[0], dims[1], n_threads=1)
+            if ok[0]:  # already BGR storage order
+                return imageArrayToStruct(batch[0], origin=origin)
+            # corrupt/unusual JPEG: let PIL take its own shot below
+    return PIL_decode(raw_bytes, origin=origin)
+
+
+def createNativeImageLoader(height: int, width: int, scale: float = 1.0):
+    """Build a URI→ndarray ``imageLoader`` (float32 RGB, values in
+    [0, 255]·scale) whose ``batch_decode`` attribute routes a WHOLE URI
+    batch through one threaded native decode+resize call — the pack-stage
+    fast path ``load_uri_batch`` uses for
+    KerasImageFileTransformer/Estimator. Per-URI calls and non-JPEG files
+    fall back to PIL; a file failing both raises (the estimator path's
+    strictness)."""
+
+    def _pil_one(uri: str) -> np.ndarray:
+        img = Image.open(uri).convert("RGB").resize(
+            (width, height), Image.BILINEAR)
+        return np.asarray(img, np.float32) * scale
+
+    def loader(uri: str) -> np.ndarray:
+        from tpudl import native
+
+        if native.available():
+            with open(uri, "rb") as f:
+                raw = f.read()
+            batch, ok = native.decode_resize_batch(
+                [raw], height, width, n_threads=1)
+            if ok[0]:
+                return batch[0][:, :, ::-1].astype(np.float32) * scale
+        return _pil_one(uri)
+
+    def batch_decode(uris) -> np.ndarray:
+        from tpudl import native
+
+        uris = list(uris)
+        if not uris:
+            return np.zeros((0, height, width, 3), np.float32)
+        if not native.available():
+            return np.stack([_pil_one(u) for u in uris])
+        raws = []
+        for u in uris:
+            with open(u, "rb") as f:
+                raws.append(f.read())
+        batch, ok = native.decode_resize_batch(raws, height, width)
+        out = batch[:, :, :, ::-1].astype(np.float32) * scale
+        for i, good in enumerate(ok):
+            if not good:
+                out[i] = _pil_one(uris[i])
+        return out
+
+    loader.batch_decode = batch_decode
+    return loader
+
+
 def resizeImage(imageRow: dict, height: int, width: int) -> dict:
     """Bilinear host resize of an image struct, PIL-backed.
 
@@ -303,5 +403,8 @@ def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None):
 
 
 def readImages(path, numPartition: int | None = None):
-    """Default-decode variant (PIL), matching pre-2.3 sparkdl readImages."""
-    return readImagesWithCustomFn(path, PIL_decode, numPartition=numPartition)
+    """Default-decode variant matching pre-2.3 sparkdl readImages —
+    native libjpeg for JPEGs when available, PIL otherwise
+    (:func:`default_decode`)."""
+    return readImagesWithCustomFn(path, default_decode,
+                                  numPartition=numPartition)

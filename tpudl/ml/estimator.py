@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 
 import numpy as np
 
@@ -54,6 +55,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         self._setDefault(kerasFitParams={"batch_size": 32, "epochs": 1,
                                          "verbose": 0})
         self.mesh = mesh
+        self._save_lock = threading.Lock()  # shared keras write-back
         kwargs = dict(self._input_kwargs)
         kwargs.pop("mesh", None)
         self._set(**kwargs)
@@ -82,7 +84,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         return X, y
 
     # -- one trial ---------------------------------------------------------
-    def _train_one(self, gin, X, y, params_map=None):
+    def _train_one(self, gin, X, y, params_map=None, device=None):
         conf = self.copy(params_map) if params_map else self
         fit_params = conf._validateFitParams(conf.getKerasFitParams())
         batch_size = int(fit_params.get("batch_size", 32))
@@ -108,7 +110,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             p = jax.tree.map(lambda a, u: a + u, p, updates)
             return p, opt_state, loss
 
-        params = jax.tree.map(jax.numpy.asarray, gin.params)
+        # device pinning: a trial scheduled onto a mesh slice commits its
+        # params to that slice's device; computation follows the operands,
+        # so concurrent trials run on disjoint devices (ref _fitInParallel's
+        # one-task-per-paramMap, re-owned as one-slice-per-trial)
+        put = ((lambda t: jax.device_put(t, device)) if device is not None
+               else (lambda t: jax.tree.map(jax.numpy.asarray, t)))
+        params = put(gin.params)
         opt_state = optimizer.init(params)
         rng = np.random.default_rng(seed)
         n = len(X)
@@ -124,8 +132,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 if len(idx) < batch_size:
                     pad = order[: batch_size - len(idx)]
                     idx = np.concatenate([idx, pad])
+                xb, yb = X[idx], y[idx]
+                if device is not None:
+                    xb, yb = jax.device_put((xb, yb), device)
                 params, opt_state, loss = train_step(
-                    params, opt_state, X[idx], y[idx])
+                    params, opt_state, xb, yb)
             losses.append(float(loss))
         return params, losses
 
@@ -165,40 +176,70 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             var_keys.append(key)
         return model, gin, var_keys
 
-    def _fit(self, frame):
+    def _fit(self, frame, device=None):
         X, y = self._getNumpyFeaturesAndLabels(frame)
         model, gin, var_keys = self._ingest()
-        params, _losses = self._train_one(gin, X, y)
+        params, _losses = self._train_one(gin, X, y, device=device)
         path = self._save_trained(model, var_keys, params)
         return self._make_transformer(path)
 
+    def _overrides_shared(self, conf):
+        """Does ``conf`` override a data/model param vs self? Compared by
+        VALUE (an equal-valued override must not force the expensive
+        private path); identity is the fallback for un-comparable values
+        (e.g. loader callables)."""
+        for p in (self.modelFile, self.inputCol, self.labelCol,
+                  self.imageLoader):
+            if p not in conf._paramMap:
+                continue
+            new, old = conf._paramMap[p], self._paramMap.get(p)
+            try:
+                if not bool(new == old):
+                    return True
+            except Exception:
+                if new is not old:
+                    return True
+        return False
+
     def fitMultiple(self, frame, paramMaps):
-        """One shared dataset + one shared ingested graph; trials run as
-        jit-compiled optax loops, yielded as they finish (ref fitMultiple
-        ~L150 contract; _fitInParallel architecture replaced per above).
+        """One shared dataset + one shared ingested graph; independent
+        trials are scheduled CONCURRENTLY onto mesh slices (one device
+        slice per in-flight trial — the reference's one-Spark-task-per-
+        paramMap, SURVEY.md §2.4/§7.3) and yielded as ``(index, model)``
+        in completion order (ref fitMultiple ~L150 contract, consumed by
+        CrossValidator).
 
         Sharing is only valid for trials that tune training knobs; a
         paramMap overriding the data/model params (modelFile, inputCol,
         labelCol, imageLoader) gets a full private ``_fit``.
         """
-        shared = (self.modelFile, self.inputCol, self.labelCol,
-                  self.imageLoader)
-        X = y = model = gin = var_keys = None
+        from tpudl.ml.hpo import TrialScheduler
+
+        paramMaps = list(paramMaps)
 
         def gen():
-            nonlocal X, y, model, gin, var_keys
-            for i, pm in enumerate(paramMaps):
-                conf = self.copy(pm)
-                if any(p in conf._paramMap
-                       and conf._paramMap[p] is not self._paramMap.get(p)
-                       for p in shared):
-                    yield i, conf._fit(frame)
-                    continue
-                if X is None:
-                    X, y = self._getNumpyFeaturesAndLabels(frame)
-                    model, gin, var_keys = self._ingest()
-                params, _losses = self._train_one(gin, X, y, pm)
-                path = self._save_trained(model, var_keys, params)
-                yield i, conf._make_transformer(path)
+            confs = [self.copy(pm) for pm in paramMaps]
+            private = {i for i, c in enumerate(confs)
+                       if self._overrides_shared(c)}
+            X = y = model = gin = var_keys = None
+            if len(private) < len(confs):
+                X, y = self._getNumpyFeaturesAndLabels(frame)
+                model, gin, var_keys = self._ingest()
+            devices = (list(self.mesh.devices.flat)
+                       if self.mesh is not None else None)
+            sched = TrialScheduler(devices=devices)
+
+            def trial(i, pm, slice_devs):
+                if i in private:
+                    # private trials stay on their slice too, or they'd
+                    # collide with pinned trials on the default device
+                    return confs[i]._fit(frame, device=slice_devs[0])
+                params, _losses = self._train_one(gin, X, y, pm,
+                                                  device=slice_devs[0])
+                with self._save_lock:  # keras model object is shared
+                    path = self._save_trained(model, var_keys, params)
+                return confs[i]._make_transformer(path)
+
+            yield from sched.run(paramMaps, trial)
 
         return gen()

@@ -1,0 +1,84 @@
+"""Task-parallel hyperparameter search over mesh slices.
+
+TPU-native rebuild of the reference's trial parallelism
+(ref: python/sparkdl/estimators/keras_image_file_estimator.py
+``_fitInParallel`` ~L250 — one Spark task per paramMap over broadcast
+ndarrays). The Spark scheduler's role is re-owned here: the device pool
+is carved into one slice per in-flight trial (SURVEY.md §2.4 "one
+model-replica per mesh slice"), trials run concurrently from a thread
+pool — JAX dispatch is thread-safe and XLA execution releases the GIL,
+so trials on distinct devices genuinely overlap — and results are
+yielded in COMPLETION order (the upstream CrossValidator contract).
+
+The dataset is shared host RAM; each trial places its batches on its own
+slice. No collect, no broadcast, no per-task recompile of the ingested
+model (trials re-jit per device, which on same-shape trials is an XLA
+cache hit per device).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Callable, Iterator, Sequence
+
+import jax
+
+__all__ = ["TrialScheduler", "device_slices"]
+
+
+def device_slices(n_trials: int, devices: Sequence | None = None,
+                  ) -> list[list]:
+    """Carve the device pool into one slice per concurrently-running
+    trial. With fewer trials than devices, slices are widened (extra
+    devices would idle); with more trials than devices, slices are one
+    device each and the pool throttles concurrency."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n_slices = max(1, min(n_trials, len(devs)))
+    width = len(devs) // n_slices
+    return [devs[i * width:(i + 1) * width] for i in range(n_slices)]
+
+
+class TrialScheduler:
+    """Run ``trial_fn(index, item, devices)`` for every item, at most one
+    in-flight trial per device slice, yielding ``(index, result)`` as
+    trials FINISH (not in submission order).
+
+    ``trial_fn`` must be thread-safe apart from its slice: shared host
+    data may be read freely; writes to shared objects need the caller's
+    own locking (see KerasImageFileEstimator._save_trained).
+    """
+
+    def __init__(self, devices: Sequence | None = None,
+                 max_parallel: int | None = None):
+        self._devices = (list(devices) if devices is not None
+                         else jax.devices())
+        self._max_parallel = max_parallel
+
+    def run(self, items: Sequence, trial_fn: Callable,
+            ) -> Iterator[tuple[int, object]]:
+        items = list(items)
+        if not items:
+            return
+        slices = device_slices(len(items), self._devices)
+        if self._max_parallel:
+            slices = slices[: self._max_parallel]
+        free = list(range(len(slices)))
+        free_lock = threading.Lock()
+
+        def run_one(i, item):
+            with free_lock:
+                s = free.pop()
+            try:
+                return i, trial_fn(i, item, slices[s])
+            finally:
+                with free_lock:
+                    free.append(s)
+
+        with ThreadPoolExecutor(max_workers=len(slices)) as pool:
+            futures = {pool.submit(run_one, i, item)
+                       for i, item in enumerate(items)}
+            while futures:
+                done, futures = wait(futures, return_when=FIRST_COMPLETED)
+                for f in done:
+                    yield f.result()

@@ -40,7 +40,19 @@ class CanLoadImage(Params):
 def load_uri_batch(loader, uris) -> np.ndarray:
     """Apply ``loader`` to each URI and stack into one float32 batch —
     shared by the estimator's bulk load and the file-transformer's
-    per-batch pack stage."""
+    per-batch pack stage.
+
+    Loaders carrying a ``batch_decode`` attribute (e.g.
+    ``imageIO.createNativeImageLoader``) get the whole batch in one call —
+    the threaded native decode+resize fast path."""
+    batched = getattr(loader, "batch_decode", None)
+    if batched is not None:
+        out = np.asarray(batched(uris), dtype=np.float32)
+        if out.ndim != 4:
+            raise ValueError(
+                f"batch_decode returned shape {out.shape}; expected "
+                "(N, H, W, C)")
+        return out
     arrays = []
     for uri in uris:
         arr = np.asarray(loader(uri))

@@ -34,10 +34,18 @@ def lib_path() -> str:
 
 
 def build(force: bool = False) -> bool:
-    """Compile decode.cpp → libtpudl_decode.so. Returns success."""
+    """Compile decode.cpp → libtpudl_decode.so. Returns success.
+
+    The .so is a build artifact, never committed (round-1 advice): it is
+    compiled from source on first use and recompiled whenever decode.cpp
+    is newer than the existing library."""
     global _build_failed
     if os.path.exists(_LIB) and not force:
-        return True
+        # no source alongside a shipped .so → trust the .so (the ABI
+        # check at load time still guards staleness)
+        if (not os.path.exists(_SRC)
+                or os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return True
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
            "-ljpeg", "-lpthread", "-o", _LIB]
     try:
@@ -69,6 +77,19 @@ def _load():
             log.warning("native lib load failed: %r", e)
             _build_failed = True
             return None
+        if (not hasattr(lib, "tpudl_native_abi_version")
+                or lib.tpudl_native_abi_version() != 1):
+            log.warning("native ABI mismatch/stale library; rebuilding")
+            if not build(force=True):
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(_LIB)
+            if (not hasattr(lib, "tpudl_native_abi_version")
+                    or lib.tpudl_native_abi_version() != 1):
+                # dlopen may have returned the cached stale mapping
+                log.warning("native library still stale after rebuild")
+                _build_failed = True
+                return None
         lib.tpudl_decode_resize_batch.restype = ctypes.c_int
         lib.tpudl_decode_resize_batch.argtypes = [
             ctypes.POINTER(ctypes.c_char_p),
@@ -78,12 +99,6 @@ def _load():
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_int,
         ]
-        if lib.tpudl_native_abi_version() != 1:
-            log.warning("native ABI mismatch; rebuilding")
-            if not build(force=True):
-                _build_failed = True
-                return None
-            lib = ctypes.CDLL(_LIB)
         _lib = lib
     return _lib
 

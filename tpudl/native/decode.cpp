@@ -105,28 +105,26 @@ bool decode_one(const uint8_t* data, size_t size, int out_h, int out_w,
     if (sh >= out_h && sw >= out_w) break;
   }
   jpeg_start_decompress(&cinfo);
+  // out_color_space = JCS_RGB above makes libjpeg emit 3 components for
+  // every convertible source (grayscale included); unconvertible color
+  // spaces error out through error_exit -> caller's PIL fallback.
   const int sh = cinfo.output_height, sw = cinfo.output_width;
   const int row_stride = sw * cinfo.output_components;
-  if (cinfo.output_components != 3) {
-    // grayscale etc: decode then widen
-  }
   std::vector<uint8_t> decoded(static_cast<size_t>(sh) * sw * 3);
   std::vector<uint8_t> row(row_stride);
   uint8_t* rowp = row.data();
   for (int y = 0; y < sh; ++y) {
     jpeg_read_scanlines(&cinfo, &rowp, 1);
-    uint8_t* dst = decoded.data() + static_cast<size_t>(y) * sw * 3;
-    if (cinfo.output_components == 3) {
-      std::memcpy(dst, rowp, static_cast<size_t>(sw) * 3);
-    } else {  // grayscale -> replicate
-      for (int x = 0; x < sw; ++x) {
-        uint8_t v = rowp[x * cinfo.output_components];
-        dst[x * 3] = dst[x * 3 + 1] = dst[x * 3 + 2] = v;
-      }
-    }
+    std::memcpy(decoded.data() + static_cast<size_t>(y) * sw * 3, rowp,
+                static_cast<size_t>(sw) * 3);
   }
   jpeg_finish_decompress(&cinfo);
+  // Truncated/corrupt-but-recoverable streams surface as libjpeg
+  // warnings (padded gray output), not error_exit. The reference's PIL
+  // path rejects such files (null-row discipline) — match it.
+  const long warnings = cinfo.err->num_warnings;
   jpeg_destroy_decompress(&cinfo);
+  if (warnings > 0) return false;
 
   std::vector<uint8_t> resized(static_cast<size_t>(out_h) * out_w * 3);
   resize_bilinear(decoded.data(), sh, sw, resized.data(), out_h, out_w);
