@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-command gate (ref: python/run-tests.sh — SURVEY.md §2.5): the full
+# suite on the simulated 8-device CPU mesh, then the driver's multi-chip
+# dry run, then a single-chip compile check of the flagship entry point.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== pytest (simulated 8-device CPU mesh) =="
+python -m pytest tests/ -q "$@"
+
+echo "== multi-chip dryrun (8-device virtual mesh) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== single-chip entry compile check =="
+python - <<'EOF'
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")  # CI-safe; TPU hosts: remove
+import numpy as np
+import __graft_entry__ as g
+fn, args = g.entry()
+out = np.asarray(jax.jit(fn)(*args))
+assert np.isfinite(out).all()
+print(f"entry() ok: {out.shape}")
+EOF
+
+echo "ALL GATES GREEN"

@@ -1,0 +1,154 @@
+"""Round-2 hardening tests: SURVEY §5.2 numerical-debug hooks, the
+validated-input/output ingest checks (ref graph/utils.py), and TF2-style
+SavedModel ingestion coverage (the round-1 matrix was TF1-style only)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+class TestDebugHooks:
+    def test_debug_nans_raises_with_provenance(self):
+        from tpudl.debug import debug_nans
+
+        f = jax.jit(lambda x: jnp.log(x))
+        with debug_nans():
+            with pytest.raises(FloatingPointError, match="nan"):
+                f(jnp.array([-1.0]))
+        # state restored: NaNs flow silently again
+        assert np.isnan(np.asarray(f(jnp.array([-1.0]))))[0]
+
+    def test_checkify_fn_catches_nan(self):
+        from jax.experimental import checkify
+
+        from tpudl.debug import checkify_fn
+
+        f = checkify_fn(lambda x: jnp.log(x) * 2.0)
+        out = f(jnp.array([1.0, 2.0]))
+        assert np.allclose(out, np.log([1.0, 2.0]) * 2)
+        with pytest.raises(checkify.JaxRuntimeError, match="nan"):
+            f(jnp.array([-1.0]))
+
+    def test_checkify_fn_catches_oob_index(self):
+        from jax.experimental import checkify
+
+        from tpudl.debug import checkify_fn
+
+        f = checkify_fn(lambda x, i: x[i])
+        assert float(f(jnp.arange(4.0), 2)) == 2.0
+        with pytest.raises(checkify.JaxRuntimeError):
+            f(jnp.arange(4.0), 17)
+
+    def test_map_batches_check_finite(self):
+        from tpudl.frame import Frame
+
+        x = np.ones((8, 3), np.float32)
+        x[5, 1] = np.nan
+        frame = Frame({"x": x})
+        with pytest.raises(ValueError, match=r"rows \[5\]"):
+            frame.map_batches(lambda b: b, ["x"], ["y"], batch_size=4,
+                              check_finite=True)
+        # clean data passes; default (off) lets NaN through untouched
+        out = frame.map_batches(lambda b: b, ["x"], ["y"], batch_size=4)
+        assert np.isnan(np.stack(list(out["y"]))).any()
+
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _tiny_graph_def():
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 2], name="x")
+        w = tf.constant([[3.0], [4.0]], name="w")
+        tf.identity(tf.matmul(x, w), name="z")
+    return g.as_graph_def(add_shapes=True)
+
+
+class TestValidatedFeedsFetches:
+    def test_interior_feed_rejected(self):
+        from tpudl.ingest import TFInputGraph
+
+        gdef = _tiny_graph_def()
+        with pytest.raises(ValueError, match="not a graph input"):
+            TFInputGraph.fromGraphDef(gdef, ["w:0"], ["z:0"])
+
+    def test_missing_feed_rejected(self):
+        from tpudl.ingest import TFInputGraph
+
+        with pytest.raises(ValueError, match="not found"):
+            TFInputGraph.fromGraphDef(_tiny_graph_def(), ["nope:0"], ["z:0"])
+
+    def test_missing_fetch_rejected(self):
+        from tpudl.ingest import TFInputGraph
+
+        with pytest.raises(ValueError, match="not found"):
+            TFInputGraph.fromGraphDef(_tiny_graph_def(), ["x:0"], ["gone:0"])
+
+    def test_valid_names_pass_and_run(self):
+        from tpudl.ingest import TFInputGraph
+
+        gin = TFInputGraph.fromGraphDef(_tiny_graph_def(), ["x:0"], ["z:0"])
+        fn = gin.make_fn()
+        out = fn(np.array([[1.0, 1.0]], np.float32))
+        out = out[0] if isinstance(out, tuple) else out
+        assert np.allclose(out, [[7.0]])
+
+
+keras = pytest.importorskip("keras")
+
+
+class TestTF2SavedModelIngestion:
+    """TF2 export route: tf.saved_model.save (serve tag,
+    serving_default signature) — not the TF1 Saver/builder path the rest
+    of the factory-matrix tests exercise."""
+
+    @pytest.fixture(scope="class")
+    def tf2_export(self, tmp_path_factory):
+        keras.utils.set_random_seed(0)
+        model = keras.Sequential([
+            keras.layers.Input((3,), name="inp"),
+            keras.layers.Dense(4, activation="relu"),
+            keras.layers.Dense(2),
+        ])
+        d = str(tmp_path_factory.mktemp("tf2_sm") / "m")
+        # TF2-native export: tf.function signature -> serving_default
+        tf.saved_model.save(
+            model, d,
+            signatures=tf.function(
+                lambda x: {"out": model(x)}).get_concrete_function(
+                    tf.TensorSpec([None, 3], tf.float32, name="x")))
+        x = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+        want = model(x).numpy()
+        return d, x, want
+
+    def test_from_saved_model_with_signature(self, tf2_export):
+        from tpudl.ingest import TFInputGraph
+
+        d, x, want = tf2_export
+        gin = TFInputGraph.fromSavedModelWithSignature(
+            d, "serve", "serving_default")
+        assert gin.input_tensor_name_from_signature
+        fn = gin.make_fn()
+        got = fn(x)
+        got = got[0] if isinstance(got, tuple) else got
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_tf_transformer_end_to_end(self, tf2_export):
+        from tpudl.frame import Frame
+        from tpudl.ingest import TFInputGraph
+        from tpudl.ml.tf_tensor import TFTransformer
+
+        d, x, want = tf2_export
+        gin = TFInputGraph.fromSavedModelWithSignature(
+            d, "serve", "serving_default")
+        t = TFTransformer(
+            tfInputGraph=gin,
+            inputMapping={"v": gin.input_names[0]},
+            outputMapping={gin.output_names[0]: "out"},
+            batchSize=3)
+        out = t.transform(Frame({"v": x}))
+        got = np.stack(list(out["out"]))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

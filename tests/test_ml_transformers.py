@@ -341,3 +341,32 @@ class TestPipeline:
         out = model.transform(frame)
         norms = np.linalg.norm(np.stack(list(out["scaled"])), axis=1)
         np.testing.assert_allclose(norms, 1.0, rtol=1e-5)
+
+
+def test_cached_jit_retains_multiple_configs():
+    """Round-1 weak item: the one-slot jit cache retraced every call when
+    two configs alternated on one instance."""
+    from tpudl.ml.pipeline import Transformer
+
+    class T(Transformer):
+        def _transform(self, frame):
+            return frame
+
+    t = T()
+    builds = []
+
+    def make(tag):
+        def build():
+            builds.append(tag)
+            return lambda x: x
+        return build
+
+    for _ in range(3):  # alternate two keys; each must compile once
+        t._cached_jit(("a",), make("a"))
+        t._cached_jit(("b",), make("b"))
+    assert builds == ["a", "b"]
+    # eviction at capacity: oldest key rebuilt after overflow
+    for i in range(T._JIT_CACHE_SIZE):
+        t._cached_jit(("k", i), make(f"k{i}"))
+    t._cached_jit(("a",), make("a2"))  # "a" was evicted → rebuilt
+    assert builds[-1] == "a2"

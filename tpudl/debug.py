@@ -1,0 +1,57 @@
+"""Numerical-debug hooks — SURVEY.md §5.2's moral equivalents.
+
+The reference has no sanitizers (thread-safety by frozen-protobuf
+avoidance); the survey prescribes the JAX-native analogues for the
+rebuild: ``jax_debug_nans`` for device-side NaN provenance and
+``checkify`` for value checks inside jitted programs. Host-side input
+checking lives in ``Frame.map_batches(check_finite=True)`` (the input
+pipeline is host-side; a numpy check there is free and catches bad rows
+before they poison a fused device program).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["debug_nans", "checkify_fn"]
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Within the block, any NaN produced by a jitted program raises with
+    the op that made it (re-runs un-jitted on failure — debugging tool,
+    not a production mode)."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def checkify_fn(fn, *, nan: bool = True, div: bool = True,
+                oob: bool = True):
+    """Wrap a jax-traceable ``fn`` with ``checkify`` error instrumentation
+    (NaN production, division, out-of-bounds indexing — the survey's
+    bounds checks for the input pipeline). The wrapper is jittable; the
+    first error raises ``jax.experimental.checkify.JaxRuntimeError`` at
+    call time instead of silently propagating garbage."""
+    from jax.experimental import checkify
+
+    errors = set()
+    if nan:
+        errors |= checkify.nan_checks
+    if div:
+        errors |= checkify.div_checks
+    if oob:
+        errors |= checkify.index_checks
+    checked = checkify.checkify(fn, errors=errors)
+
+    def wrapper(*args, **kwargs):
+        err, out = checked(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper

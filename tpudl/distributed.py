@@ -32,17 +32,11 @@ __all__ = [
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None, **kwargs) -> None:
-    """Join the multi-host gang. No-op on a single host with no
-    coordinator configured (env-driven TPU pods need no arguments —
-    jax autodetects; explicit args are for DCN/GPU-style bring-up)."""
+    """Join the multi-host gang. With no arguments this is a documented
+    NO-OP: TPU pod slices autodetect through the runtime and a bare
+    single host needs no distributed init at all. Pass explicit args for
+    DCN/GPU-style bring-up."""
     if coordinator_address is None and num_processes is None:
-        # TPU pod slices autodetect via the runtime; bare single host
-        # needs no distributed init at all.
-        try:
-            if jax.process_count() > 1:
-                return  # already initialized by the runtime
-        except RuntimeError:
-            pass
         return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,

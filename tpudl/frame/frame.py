@@ -144,9 +144,10 @@ class Frame:
         input_cols: Sequence[str],
         output_cols: Sequence[str],
         *,
-        batch_size: int = 256,
+        batch_size: int | None = None,
         mesh=None,
         pack: Callable | None = None,
+        check_finite: bool = False,
     ) -> "Frame":
         """Run ``fn`` over the frame in device-sized batches; append outputs.
 
@@ -157,7 +158,16 @@ class Frame:
         size and sharded before the call (the infeed edge); outputs are
         fetched and unpadded. This is the rebuild of the reference's
         per-partition TensorFrames MapBlocks execution, minus the JVM.
+
+        ``batch_size`` defaults to the frame's ``num_partitions`` hint
+        (``ceil(rows / num_partitions)`` — the Spark-side meaning of a
+        partition as the unit of executor dispatch), else 256.
         """
+        if batch_size is None:
+            if self.num_partitions:
+                batch_size = max(1, -(-self._n // int(self.num_partitions)))
+            else:
+                batch_size = 256
         if mesh is not None:
             from tpudl import mesh as M  # jax import only on the mesh path
 
@@ -176,6 +186,15 @@ class Frame:
             for c in input_cols:
                 sl = self._cols[c][start:stop]
                 arr = pack(sl) if pack is not None else _default_pack(sl)
+                if check_finite and np.issubdtype(arr.dtype, np.floating):
+                    # input-pipeline sanitizer (SURVEY.md §5.2): catch bad
+                    # rows host-side before they enter a fused program
+                    bad = ~np.isfinite(arr).reshape(arr.shape[0], -1).all(1)
+                    if bad.any():
+                        rows = (np.nonzero(bad)[0][:8] + start).tolist()
+                        raise ValueError(
+                            f"non-finite values in column {c!r}, rows "
+                            f"{rows} (batch {start}:{stop})")
                 packed.append(arr)
             n_pad = 0
             if mesh is not None:

@@ -353,16 +353,24 @@ def _listFiles(path: str | Iterable[str]) -> list[str]:
     return [path]
 
 
-def filesToFrame(path, numPartitions: int | None = None):
+def filesToFrame(path, numPartitions: int | None = None,
+                 host_sharded: bool = False):
     """Read raw file bytes into a Frame with columns (filePath, fileData).
 
     ref: imageIO.filesToDF (~L200) — sc.binaryFiles → DataFrame[filePath,
-    fileData]. numPartitions is kept for API parity and forwarded as the
-    Frame's partition hint (used by map_batches scheduling).
+    fileData]. ``numPartitions`` is the Frame's partition hint: it sets
+    ``map_batches``'s default dispatch granularity
+    (``batch_size ≈ rows/numPartitions``). ``host_sharded=True`` reads
+    only THIS host's shard of the file list (tpudl.distributed.host_shard
+    — the multi-host input plane replacing Spark partition assignment).
     """
     from tpudl.frame import Frame
 
     paths = _listFiles(path)
+    if host_sharded:
+        from tpudl import distributed as D
+
+        paths = D.host_shard(paths)
     datas = []
     for p in paths:
         with open(p, "rb") as f:
@@ -373,7 +381,8 @@ def filesToFrame(path, numPartitions: int | None = None):
     )
 
 
-def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None):
+def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None,
+                           host_sharded: bool = False):
     """Read a directory of images with a custom decode function → Frame["image"].
 
     ref: imageIO.readImagesWithCustomFn (~L220): binaryFiles → decode_f per
@@ -381,7 +390,8 @@ def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None):
     ``decode_f`` takes raw bytes and returns an ndarray (H, W, C) **in BGR
     storage order** or an image struct dict or None.
     """
-    frame = filesToFrame(path, numPartitions=numPartition)
+    frame = filesToFrame(path, numPartitions=numPartition,
+                         host_sharded=host_sharded)
     structs = []
     for origin, raw in zip(frame["filePath"], frame["fileData"]):
         try:

@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["UnsupportedOpError", "build_jax_fn", "tensor_name", "op_name"]
+__all__ = ["UnsupportedOpError", "build_jax_fn", "tensor_name", "op_name",
+           "validated_input", "validated_output"]
 
 
 class UnsupportedOpError(NotImplementedError):
@@ -52,6 +53,39 @@ def op_name(name: str) -> str:
     """Canonicalize ``"x:0"`` → ``"x"`` (op/node form)."""
     name = name.lstrip("^")
     return name.split(":")[0]
+
+
+def node_op_map(graph_def) -> dict:
+    """{node name → op type} for validator reuse — build ONCE per graph;
+    frozen imagenet-scale protos hold thousands of nodes."""
+    return {n.name: n.op for n in graph_def.node}
+
+
+def validated_input(graph_def, name: str, nodes: dict | None = None) -> str:
+    """Canonical tensor name for a FEED, verified to be a genuine graph
+    input (a Placeholder node) — the rebuild of ref graph/utils.py
+    validated_input: feeding an interior tensor is a silent-wrong-result
+    bug in the translated program, so it is rejected here."""
+    nodes = nodes if nodes is not None else node_op_map(graph_def)
+    op = op_name(name)
+    if op not in nodes:
+        raise ValueError(
+            f"input {name!r} not found in graph ({len(nodes)} nodes)")
+    if nodes[op] not in ("Placeholder", "PlaceholderWithDefault"):
+        raise ValueError(
+            f"input {name!r} is a {nodes[op]!r} node, not a graph "
+            "input (Placeholder); feeds must be genuine inputs")
+    return tensor_name(name)
+
+
+def validated_output(graph_def, name: str, nodes: dict | None = None) -> str:
+    """Canonical tensor name for a FETCH, verified to exist in the graph
+    (ref graph/utils.py validated_output)."""
+    nodes = nodes if nodes is not None else node_op_map(graph_def)
+    if op_name(name) not in nodes:
+        raise ValueError(
+            f"output {name!r} not found in graph ({len(nodes)} nodes)")
+    return tensor_name(name)
 
 
 def _np_dtype(tf_enum: int):

@@ -26,7 +26,9 @@ import os
 
 import numpy as np
 
-from tpudl.ingest.graphdef import build_jax_fn, op_name, tensor_name
+from tpudl.ingest.graphdef import (build_jax_fn, node_op_map, op_name,
+                                   tensor_name, validated_input,
+                                   validated_output)
 
 __all__ = ["TFInputGraph"]
 
@@ -59,8 +61,15 @@ class TFInputGraph:
                  input_sig=None, output_sig=None, params=None,
                  capture_map=None):
         self.graph_def = graph_def
-        self.input_names = [tensor_name(n) for n in input_names]
-        self.output_names = [tensor_name(n) for n in output_names]
+        # feed/fetch validation at ingest time (ref: graph/utils.py
+        # validated_input/validated_output): a feed that is not a real
+        # graph input, or a fetch that does not exist, fails HERE with a
+        # name-level error instead of deep inside the translator.
+        nodes = node_op_map(graph_def)
+        self.input_names = [validated_input(graph_def, n, nodes)
+                            for n in input_names]
+        self.output_names = [validated_output(graph_def, n, nodes)
+                             for n in output_names]
         self.input_tensor_name_from_signature = input_sig
         self.output_tensor_name_from_signature = output_sig
         self.params = params  # non-None only for the trainable route
@@ -101,24 +110,43 @@ class TFInputGraph:
 
     @classmethod
     def fromSavedModel(cls, saved_model_dir, tag_set, feed_names, fetch_names):
-        """SavedModel with explicit feeds/fetches (ref: ~L150)."""
-        gdef, _meta = _load_saved_model_frozen(saved_model_dir, tag_set,
-                                               fetch_names)
+        """SavedModel with explicit feeds/fetches (ref: ~L150). TF1-style
+        exports freeze through the v1 session; TF2 object-graph exports
+        (resource variables the v1 freeze cannot read) go through the v2
+        concrete-function route with the user's names validated against
+        the frozen graph."""
+        try:
+            gdef, _meta = _load_saved_model_frozen(saved_model_dir, tag_set,
+                                                   fetch_names)
+        except Exception:
+            v2 = _load_saved_model_v2(saved_model_dir, None)
+            if v2 is None:
+                raise
+            gdef, _in_sig, _out_sig = v2
         return cls(gdef, feed_names, fetch_names)
 
     @classmethod
     def fromSavedModelWithSignature(cls, saved_model_dir, tag_set,
                                     signature_def_key):
         """SavedModel; feeds/fetches resolved from its SignatureDef
-        (ref: ~L180)."""
+        (ref: ~L180). Handles both TF1 exports (v1 loader + freeze) and
+        TF2 exports (signature concrete function + v2 freeze)."""
         tf = _tf()
-        with tf.Graph().as_default() as g, tf.compat.v1.Session(graph=g) as sess:
-            meta = tf.compat.v1.saved_model.loader.load(
-                sess, _tags(tag_set), saved_model_dir)
-            in_sig, out_sig = _signature_maps(meta, signature_def_key)
+        try:
+            with tf.Graph().as_default() as g, \
+                    tf.compat.v1.Session(graph=g) as sess:
+                meta = tf.compat.v1.saved_model.loader.load(
+                    sess, _tags(tag_set), saved_model_dir)
+                in_sig, out_sig = _signature_maps(meta, signature_def_key)
+                fetch_names = list(out_sig.values())
+                gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
+                                  fetch_names)
+        except Exception:
+            v2 = _load_saved_model_v2(saved_model_dir, signature_def_key)
+            if v2 is None:
+                raise
+            gdef, in_sig, out_sig = v2
             fetch_names = list(out_sig.values())
-            gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
-                              fetch_names)
         return cls(gdef, list(in_sig.values()), fetch_names,
                    input_sig=in_sig, output_sig=out_sig)
 
@@ -248,6 +276,41 @@ def _load_saved_model_frozen(saved_model_dir, tag_set, fetch_names):
         gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
                           fetch_names)
     return gdef, meta
+
+
+def _load_saved_model_v2(saved_model_dir, signature_def_key):
+    """TF2 object-graph SavedModel → (frozen gdef, in_sig, out_sig) via
+    the signature's concrete function, or None when the artifact has no
+    usable v2 signatures. TF's nest flattens dict structures in sorted
+    key order, which is how logical names line up with the frozen
+    graph's input/output tensors."""
+    tf = _tf()
+    try:
+        loaded = tf.saved_model.load(saved_model_dir)
+        signatures = dict(getattr(loaded, "signatures", {}))
+    except Exception:
+        return None
+    if not signatures:
+        return None
+    key = signature_def_key or "serving_default"
+    if key not in signatures:
+        raise KeyError(
+            f"SignatureDef {key!r} not found; available: "
+            f"{sorted(signatures)}")
+    cf = signatures[key]
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    frozen = convert_variables_to_constants_v2(cf)
+    gdef = frozen.graph.as_graph_def(add_shapes=True)
+    kwargs = cf.structured_input_signature[1]
+    in_sig = {name: t.name
+              for name, t in zip(sorted(kwargs), frozen.inputs)}
+    outs = cf.structured_outputs
+    out_keys = sorted(outs) if isinstance(outs, dict) else [
+        f"output_{i}" for i in range(len(frozen.outputs))]
+    out_sig = {name: t.name for name, t in zip(out_keys, frozen.outputs)}
+    return gdef, in_sig, out_sig
 
 
 def _load_checkpoint_frozen(checkpoint_dir, fetch_names):

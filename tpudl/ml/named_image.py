@@ -54,13 +54,33 @@ def load_named_params(model_name: str, weights: str = "random") -> dict:
         # the device tunnel)
         params = model.init(0)
     elif weights == "imagenet":
-        from tpudl.zoo.convert import params_from_keras
+        # offline artifact first when $TPUDL_WEIGHTS_DIR is set (see
+        # zoo.convert.save_named_params) — no keras download attempt on
+        # egress-less hosts; else the live keras cache/download.
+        wdir = os.environ.get("TPUDL_WEIGHTS_DIR")
+        art = os.path.join(wdir, f"{model_name}.npz") if wdir else None
+        if art and os.path.exists(art):
+            from tpudl.zoo.convert import load_params_npz
 
-        kmodel = model.keras_builder()(weights="imagenet")
-        params = params_from_keras(kmodel)
+            params = load_params_npz(art)
+        else:
+            try:
+                from tpudl.zoo.convert import params_from_keras
+
+                kmodel = model.keras_builder()(weights="imagenet")
+                params = params_from_keras(kmodel)
+            except Exception as e:
+                raise RuntimeError(
+                    f"imagenet weights unavailable (keras download failed: "
+                    f"{e!r}) and no offline artifact at "
+                    f"{art or '$TPUDL_WEIGHTS_DIR/' + model_name + '.npz'!r}."
+                    f" Run tpudl.zoo.convert.save_named_params("
+                    f"{model_name!r}, '<dir>/{model_name}.npz') once on a "
+                    "networked host and set TPUDL_WEIGHTS_DIR=<dir>.") from e
     elif weights.endswith(".npz"):
-        with np.load(weights, allow_pickle=True) as z:
-            params = z["params"].item()
+        from tpudl.zoo.convert import load_params_npz
+
+        params = load_params_npz(weights)
     else:
         from tpudl.zoo.convert import load_keras_model, params_from_keras
 

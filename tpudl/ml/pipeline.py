@@ -30,6 +30,12 @@ class Transformer(Params):
     def _transform(self, frame):  # pragma: no cover - abstract
         raise NotImplementedError
 
+    # compiled programs retained per transformer instance; alternating
+    # between more configs than this on ONE instance evicts LRU-style
+    # (a single-slot cache retraced every call when two configs
+    # alternated — e.g. an HPO loop flipping computeDtype)
+    _JIT_CACHE_SIZE = 8
+
     def _cached_jit(self, key, build):
         """jit ``build()`` once per ``key`` and reuse across transform()
         calls — a fresh closure per call would re-trace (and re-compile)
@@ -38,10 +44,17 @@ class Transformer(Params):
         (path, mtime) pair for file-backed models."""
         import jax
 
-        if getattr(self, "_jit_key", None) != key:
-            self._jit_fn = jax.jit(build())
-            self._jit_key = key
-        return self._jit_fn
+        cache = getattr(self, "_jit_cache", None)
+        if cache is None:
+            cache = self._jit_cache = {}
+        if key in cache:
+            cache[key] = cache.pop(key)  # refresh LRU order
+            return cache[key]
+        fn = jax.jit(build())
+        if len(cache) >= self._JIT_CACHE_SIZE:
+            cache.pop(next(iter(cache)))  # evict least-recently-used
+        cache[key] = fn
+        return fn
 
 
 class Model(Transformer):

@@ -29,6 +29,7 @@ import time
 import jax
 import numpy as np
 
+from tpudl import distributed as D
 from tpudl import mesh as M
 from tpudl.train.checkpoint import CheckpointManager
 from tpudl.train.step import make_train_step
@@ -172,9 +173,15 @@ class Trainer:
             params = M.replicate(params, self.mesh)
             opt_state = M.replicate(opt_state, self.mesh)
 
-        # A 1-wide data axis needs no explicit sharding: host arrays go
-        # straight into the jitted step, whose own arg transfer pipelines
-        # (an explicit per-step device_put serializes on tunneled backends).
+        # Multi-host: data_fn returns THIS host's slice of the global
+        # batch (use tpudl.distributed.host_shard to pick the host's
+        # files); slices assemble into one globally-sharded array whose
+        # collectives ride ICI/DCN (SURVEY.md §5.8 input data plane).
+        # Single host, multi-device: plain shard_batch. A 1-wide data
+        # axis needs no explicit sharding: host arrays go straight into
+        # the jitted step, whose own arg transfer pipelines (an explicit
+        # per-step device_put serializes on tunneled backends).
+        multi_host = self.mesh is not None and D.process_count() > 1
         shard_inputs = (self.mesh is not None
                         and self.mesh.shape[M.DATA_AXIS] > 1)
         t0 = time.perf_counter()
@@ -185,7 +192,11 @@ class Trainer:
                 batch = data_fn(step)
                 if not isinstance(batch, tuple):
                     batch = (batch,)
-                if shard_inputs:
+                if multi_host:
+                    batch = tuple(
+                        D.global_batch(np.asarray(b), self.mesh)
+                        for b in batch)
+                elif shard_inputs:
                     batch = tuple(M.shard_batch(b, self.mesh) for b in batch)
                 params, opt_state, loss = step_fn(params, opt_state, *batch)
                 examples += int(np.shape(batch[0])[0])

@@ -20,7 +20,8 @@ import numpy as np
 
 from tpudl.zoo.core import Namer
 
-__all__ = ["params_from_keras", "load_keras_model"]
+__all__ = ["params_from_keras", "load_keras_model", "save_params_npz",
+           "load_params_npz", "save_named_params"]
 
 _BASE_NAMES = {
     "Conv2D": "conv2d",
@@ -99,6 +100,49 @@ def params_from_keras(model) -> dict:
                 p["bias"] = np.asarray(layer.bias)
         params[name] = p
     return params
+
+
+def save_params_npz(params: dict, path: str) -> str:
+    """Save a param pytree as a flat, pickle-free .npz artifact
+    (``layer/param`` keys). This is the offline-distribution format — the
+    rebuild of the reference's packaged GraphDef resources (ref:
+    Models.scala ~L30, getResourceAsStream("/sparkdl/<model>.pb"))."""
+    flat = {}
+    for layer, d in params.items():
+        for k, v in d.items():
+            flat[f"{layer}/{k}"] = np.asarray(v)
+    np.savez(path, **flat)
+    return path
+
+
+def load_params_npz(path: str) -> dict:
+    """Load a .npz param artifact (flat ``layer/param`` layout, or the
+    legacy single pickled-dict layout)."""
+    with np.load(path, allow_pickle=True) as z:
+        if z.files == ["params"]:  # legacy pickled layout
+            return z["params"].item()
+        params: dict[str, dict] = {}
+        for key in z.files:
+            layer, _, pname = key.rpartition("/")
+            if not layer:
+                raise ValueError(
+                    f"{path}: unrecognized npz key {key!r} (expected "
+                    "'layer/param' entries)")
+            params.setdefault(layer, {})[pname] = z[key]
+        return params
+
+
+def save_named_params(name: str, path: str, weights: str = "imagenet") -> str:
+    """One-time conversion (run on a host with a live keras-applications
+    cache / network): build the named keras model with ``weights``,
+    convert to a pytree, save as .npz. The artifact then serves
+    ``DeepImageFeaturizer(weights="<path>.npz")`` on offline hosts —
+    the reproducible pretrained-weights delivery story."""
+    from tpudl.zoo.registry import getKerasApplicationModel
+
+    model = getKerasApplicationModel(name)
+    kmodel = model.keras_builder()(weights=weights)
+    return save_params_npz(params_from_keras(kmodel), path)
 
 
 def load_keras_model(path_or_model):
