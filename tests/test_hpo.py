@@ -204,3 +204,101 @@ class TestEstimatorParallelHPO:
         assert not est._overrides_shared(conf)
         conf2 = est.copy({est.modelFile: "/somewhere/else.keras"})
         assert est._overrides_shared(conf2)
+
+    def test_override_equal_to_default_stays_shared(self, tiny_sets):
+        """ADVICE round 2: a paramMap entry equal to a DEFAULT value was
+        misclassified as an override (compared against _paramMap.get →
+        None) and forced the expensive private _fit."""
+        from tpudl.ml import KerasImageFileEstimator
+
+        uris, labels, model_path = tiny_sets
+        est = self._est(model_path)
+        # make inputCol a default rather than an explicit set
+        del est._paramMap[est.inputCol]
+        est._setDefault(inputCol="uri")
+        conf = est.copy({est.inputCol: "uri"})  # equal to the default
+        assert not est._overrides_shared(conf)
+
+    def test_wide_slice_trains_as_data_parallel_submesh(self, tiny_sets):
+        """VERDICT round 2 weak #2: a trial pinned only slice_devs[0],
+        idling the rest of its slice. A width-4 slice must now place the
+        trial's params across ALL 4 devices (replicated over a
+        data-parallel sub-mesh)."""
+        from tpudl.frame import Frame
+
+        if jax.device_count() < 4:
+            pytest.skip("needs >=4 devices")
+        uris, labels, model_path = tiny_sets
+        est = self._est(model_path)
+        frame = Frame({"uri": uris, "label": labels})
+        X, y = est._getNumpyFeaturesAndLabels(frame)
+        _model, gin, _vk = est._ingest()
+        slice_devs = jax.devices()[:4]
+        params, losses = est._train_one(gin, X, y, devices=slice_devs)
+        leaf = jax.tree.leaves(params)[0]
+        assert leaf.sharding.device_set == set(slice_devs), (
+            f"trial used {leaf.sharding.device_set} — not its whole slice")
+        assert np.isfinite(losses).all()
+
+    def test_two_trials_on_eight_devices_use_all_devices(self, tiny_sets):
+        """VERDICT round 2 next #4 done-criterion: a 2-trial run on 8
+        devices exercises >2 devices (here: all 8 — two 4-wide disjoint
+        sub-meshes)."""
+        from tpudl import mesh as M
+        from tpudl.frame import Frame
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 devices")
+        uris, labels, model_path = tiny_sets
+        est = self._est(model_path)
+        est.mesh = M.build_mesh()
+        frame = Frame({"uri": uris, "label": labels})
+
+        used = {}
+        lock = threading.Lock()
+        orig = est._train_one
+
+        def spy(gin, X, y, pm=None, devices=None):
+            params, losses = orig(gin, X, y, pm, devices=devices)
+            leaf = jax.tree.leaves(params)[0]
+            with lock:
+                used[id(pm)] = (tuple(devices), leaf.sharding.device_set)
+            return params, losses
+
+        est._train_one = spy
+        pms = [{est.kerasFitParams: {"batch_size": 4, "epochs": 1,
+                                     "learning_rate": lr}}
+               for lr in (1e-2, 1e-3)]
+        got = dict(est.fitMultiple(frame, pms))
+        assert sorted(got) == [0, 1]
+        all_used = set().union(*(s for _d, s in used.values()))
+        assert len(all_used) == 8, (
+            f"2 trials exercised only {len(all_used)} of 8 devices")
+        slices = [set(d) for d, _s in used.values()]
+        assert slices[0].isdisjoint(slices[1]), "trial slices overlap"
+
+    def test_same_shape_trials_trace_once(self, tiny_sets):
+        """VERDICT round 2 weak #3: a fresh @jax.jit closure per trial made
+        N same-shape trials compile N times. With the shared step (lr
+        dynamic in opt_state), 4 trials with distinct learning rates on
+        one device slice must trace exactly once."""
+        from tpudl import mesh as M
+        from tpudl.frame import Frame
+
+        uris, labels, model_path = tiny_sets
+        est = self._est(model_path)
+        # width-1 pool → every trial runs on the SAME device set, so any
+        # extra trace would come from closure churn, the round-2 defect
+        est.mesh = M.build_mesh(n_data=1, devices=jax.devices()[:1])
+        frame = Frame({"uri": uris, "label": labels})
+        pms = [{est.kerasFitParams: {"batch_size": 4, "epochs": 1,
+                                     "learning_rate": lr}}
+               for lr in (1e-2, 3e-3, 1e-3, 3e-4)]
+        got = dict(est.fitMultiple(frame, pms))
+        assert sorted(got) == [0, 1, 2, 3]
+        entries = list(est._step_cache.values())
+        assert len(entries) == 1, (
+            f"{len(entries)} step-cache entries for identical (graph, "
+            "loss, optimizer) trials")
+        assert entries[0].n_traces() == 1, (
+            f"step traced {entries[0].n_traces()}× for 4 same-shape trials")

@@ -31,13 +31,18 @@ def test_npz_round_trip(tmp_path):
             assert np.array_equal(loaded[layer][k], params[layer][k])
 
 
-def test_legacy_pickled_layout_still_loads(tmp_path):
+def test_legacy_pickled_layout_requires_opt_in(tmp_path):
+    """The legacy single-'params' layout executes pickle opcodes to load,
+    so the default (the TPUDL_WEIGHTS_DIR auto-discovery path) must refuse
+    it; an explicit opt-in for a trusted file still loads."""
     params = {"dense": {"kernel": np.ones((2, 3), np.float32)}}
     path = str(tmp_path / "legacy.npz")
     arr = np.empty((), dtype=object)
     arr[()] = params
     np.savez(path, params=arr)
-    loaded = convert.load_params_npz(path)
+    with pytest.raises(ValueError, match="legacy pickled"):
+        convert.load_params_npz(path)
+    loaded = convert.load_params_npz(path, allow_legacy_pickle=True)
     assert np.array_equal(loaded["dense"]["kernel"],
                           params["dense"]["kernel"])
 

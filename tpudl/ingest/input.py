@@ -118,7 +118,8 @@ class TFInputGraph:
         try:
             gdef, _meta = _load_saved_model_frozen(saved_model_dir, tag_set,
                                                    fetch_names)
-        except Exception:
+        except Exception as v1_err:
+            _log_v1_fallback(saved_model_dir, v1_err)
             v2 = _load_saved_model_v2(saved_model_dir, None)
             if v2 is None:
                 raise
@@ -141,7 +142,8 @@ class TFInputGraph:
                 fetch_names = list(out_sig.values())
                 gdef = _freeze_v1(tf, sess, g.as_graph_def(add_shapes=True),
                                   fetch_names)
-        except Exception:
+        except Exception as v1_err:
+            _log_v1_fallback(saved_model_dir, v1_err)
             v2 = _load_saved_model_v2(saved_model_dir, signature_def_key)
             if v2 is None:
                 raise
@@ -225,6 +227,18 @@ class TFInputGraph:
 
 
 # -- loader plumbing -------------------------------------------------------
+def _log_v1_fallback(saved_model_dir, err):
+    """A genuine v1 failure (wrong tag set, corrupt proto, OOM) must stay
+    visible even when the v2 loader then succeeds with different
+    signatures — otherwise a misrouted TF1 artifact surfaces only a
+    confusing v2-side error."""
+    import logging
+
+    logging.getLogger("tpudl.ingest").warning(
+        "TF1 SavedModel load of %r failed (%s: %s); retrying with the v2 "
+        "object-graph loader", saved_model_dir, type(err).__name__, err)
+
+
 def _tags(tag_set):
     if isinstance(tag_set, str):
         return tag_set.split(",")
@@ -303,9 +317,29 @@ def _load_saved_model_v2(saved_model_dir, signature_def_key):
 
     frozen = convert_variables_to_constants_v2(cf)
     gdef = frozen.graph.as_graph_def(add_shapes=True)
-    kwargs = cf.structured_input_signature[1]
-    in_sig = {name: t.name
-              for name, t in zip(sorted(kwargs), frozen.inputs)}
+    args, kwargs = cf.structured_input_signature
+    if args:
+        raise ValueError(
+            f"signature {key!r} takes {len(args)} positional inputs; only "
+            "keyword-argument signatures bind logical names unambiguously")
+    if len(kwargs) != len(frozen.inputs):
+        raise ValueError(
+            f"signature {key!r}: {len(kwargs)} named inputs but the frozen "
+            f"graph exposes {len(frozen.inputs)} placeholders — cannot bind "
+            "logical names to tensors safely")
+    # TF nest flattens dicts in sorted-key order; cross-check each
+    # placeholder's op name against its signature spec so a flatten-order
+    # change fails loudly instead of silently misbinding multi-input feeds.
+    in_sig = {}
+    for name, t in zip(sorted(kwargs), frozen.inputs):
+        spec_name = getattr(kwargs[name], "name", None)
+        placeholder = op_name(t.name)
+        if spec_name and spec_name != placeholder and name != placeholder:
+            raise ValueError(
+                f"signature {key!r}: logical input {name!r} (spec name "
+                f"{spec_name!r}) would bind to placeholder {placeholder!r}; "
+                "refusing ambiguous binding")
+        in_sig[name] = t.name
     outs = cf.structured_outputs
     out_keys = sorted(outs) if isinstance(outs, dict) else [
         f"output_{i}" for i in range(len(frozen.outputs))]

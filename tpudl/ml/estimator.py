@@ -22,6 +22,7 @@ every executor, and re-compiles Keras per Spark task. Here:
 
 from __future__ import annotations
 
+import math
 import os
 import tempfile
 import threading
@@ -29,10 +30,12 @@ import threading
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
+from tpudl import mesh as M
 from tpudl.ml.image_params import CanLoadImage
 from tpudl.ml.keras_image import KerasImageFileTransformer
-from tpudl.ml.losses import get_loss, get_optimizer
+from tpudl.ml.losses import get_loss, get_optimizer_dynamic
 from tpudl.ml.params import (HasInputCol, HasKerasLoss, HasKerasModel,
                              HasKerasOptimizer, HasLabelCol, HasOutputCol,
                              keyword_only)
@@ -42,6 +45,26 @@ __all__ = ["KerasImageFileEstimator"]
 
 _ALLOWED_FIT_PARAMS = {"batch_size", "epochs", "verbose", "shuffle",
                        "learning_rate", "seed"}
+
+
+class _StepEntry:
+    """A shared compiled train step: jitted fn + its (dynamic-lr) optimizer
+    + trace counter (``n_traces`` lets tests assert same-shape trials
+    compile once). Holds a strong reference to the ingested graph so the
+    id()-keyed cache can never alias a recycled id from a garbage-collected
+    gin onto a stale compiled step."""
+
+    __slots__ = ("step", "optimizer", "default_lr", "gin", "_counts")
+
+    def __init__(self, step, optimizer, default_lr, gin, counts):
+        self.step = step
+        self.optimizer = optimizer
+        self.default_lr = default_lr
+        self.gin = gin
+        self._counts = counts
+
+    def n_traces(self) -> int:
+        return self._counts["traces"]
 
 
 class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
@@ -56,6 +79,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                                          "verbose": 0})
         self.mesh = mesh
         self._save_lock = threading.Lock()  # shared keras write-back
+        # one compiled train step per (ingested graph, loss, optimizer),
+        # shared across every trial (learning rate is dynamic in opt_state,
+        # see losses.get_optimizer_dynamic) — N same-shape trials trace and
+        # XLA-compile once per device slice, not once per trial. Shallow
+        # Params.copy shares this dict, so trial copies hit the same cache.
+        self._step_cache: dict = {}
+        self._step_lock = threading.Lock()
         kwargs = dict(self._input_kwargs)
         kwargs.pop("mesh", None)
         self._set(**kwargs)
@@ -83,8 +113,60 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             raise ValueError(f"{len(X)} images but {len(y)} labels")
         return X, y
 
+    # -- shared compiled step ----------------------------------------------
+    def _get_step(self, gin, loss_name, opt_name, cache=True):
+        """One jitted train step per (ingested graph, loss, optimizer),
+        shared by every trial. The learning rate is a hyperparam inside
+        opt_state, so distinct lrs do NOT fork the compilation; distinct
+        device slices compile separate executables (unavoidable — XLA
+        programs are per device set) but share the single trace cache of
+        this one function object. ``entry.n_traces()`` exposes the trace
+        count for tests.
+
+        ``cache=False`` (private _fit trials, each with a fresh gin that
+        can never be looked up again) returns an uncached entry, so dead
+        entries neither pin weight sets nor evict the hot shared step."""
+        key = (id(gin), loss_name, opt_name)
+        with self._step_lock:
+            entry = self._step_cache.get(key)
+            if entry is not None:
+                return entry
+            loss_fn = get_loss(loss_name)
+            optimizer, default_lr = get_optimizer_dynamic(opt_name)
+            apply_fn = gin.make_fn()
+            counts = {"traces": 0}
+
+            def objective(p, xb, yb):
+                pred = apply_fn(p, xb)
+                if isinstance(pred, tuple):
+                    pred = pred[0]
+                return loss_fn(pred, yb)
+
+            def train_step(p, opt_state, xb, yb):
+                counts["traces"] += 1  # python side effect: runs per trace
+                loss, grads = jax.value_and_grad(objective)(p, xb, yb)
+                updates, opt_state = optimizer.update(grads, opt_state, p)
+                p = jax.tree.map(lambda a, u: a + u, p, updates)
+                return p, opt_state, loss
+
+            entry = _StepEntry(jax.jit(train_step), optimizer, default_lr,
+                               gin, counts)
+            if cache:
+                while len(self._step_cache) >= 8:  # bound retention
+                    self._step_cache.pop(next(iter(self._step_cache)))
+                self._step_cache[key] = entry
+            return entry
+
     # -- one trial ---------------------------------------------------------
-    def _train_one(self, gin, X, y, params_map=None, device=None):
+    def _train_one(self, gin, X, y, params_map=None, devices=None,
+                   cache_step=True):
+        """Train one trial on its device slice. A width-1 slice pins the
+        trial to that device (computation follows the operands, so
+        concurrent trials run on disjoint devices — ref _fitInParallel's
+        one-task-per-paramMap, re-owned as one-slice-per-trial). A wider
+        slice becomes a data-parallel sub-mesh: params replicated, batches
+        sharded over the slice's data axis, so every device in the slice
+        works (SURVEY.md §2.4 "one model-replica per mesh slice")."""
         conf = self.copy(params_map) if params_map else self
         fit_params = conf._validateFitParams(conf.getKerasFitParams())
         batch_size = int(fit_params.get("batch_size", 32))
@@ -92,50 +174,47 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         shuffle = bool(fit_params.get("shuffle", True))
         seed = int(fit_params.get("seed", 0))
         lr = fit_params.get("learning_rate")
-        loss_fn = get_loss(conf.getKerasLoss())
-        optimizer = get_optimizer(conf.getKerasOptimizer(), lr)
+        entry = self._get_step(gin, conf.getKerasLoss(),
+                               conf.getKerasOptimizer(), cache=cache_step)
 
-        apply_fn = gin.make_fn()
+        devs = list(devices) if devices is not None else None
+        submesh = (M.build_mesh(devices=devs)
+                   if devs is not None and len(devs) > 1 else None)
+        if submesh is not None:
+            params = M.replicate(gin.params, submesh)
+        elif devs is not None:
+            params = jax.device_put(gin.params, devs[0])
+        else:
+            params = jax.tree.map(jnp.asarray, gin.params)
+        opt_state = entry.optimizer.init(params)
+        opt_state.hyperparams["learning_rate"] = jnp.asarray(
+            lr if lr is not None else entry.default_lr, dtype=jnp.float32)
 
-        def objective(p, xb, yb):
-            pred = apply_fn(p, xb)
-            if isinstance(pred, tuple):
-                pred = pred[0]
-            return loss_fn(pred, yb)
-
-        @jax.jit
-        def train_step(p, opt_state, xb, yb):
-            loss, grads = jax.value_and_grad(objective)(p, xb, yb)
-            updates, opt_state = optimizer.update(grads, opt_state, p)
-            p = jax.tree.map(lambda a, u: a + u, p, updates)
-            return p, opt_state, loss
-
-        # device pinning: a trial scheduled onto a mesh slice commits its
-        # params to that slice's device; computation follows the operands,
-        # so concurrent trials run on disjoint devices (ref _fitInParallel's
-        # one-task-per-paramMap, re-owned as one-slice-per-trial)
-        put = ((lambda t: jax.device_put(t, device)) if device is not None
-               else (lambda t: jax.tree.map(jax.numpy.asarray, t)))
-        params = put(gin.params)
-        opt_state = optimizer.init(params)
         rng = np.random.default_rng(seed)
         n = len(X)
         if n == 0:
             raise ValueError("cannot fit on an empty frame (0 images)")
+        # fixed-size batches only → one compiled step program; the ragged
+        # tail wraps around (standard TPU static-shape practice). On a
+        # sub-mesh the batch is additionally padded (by wrap-around) to a
+        # multiple of the slice width so it shards evenly.
+        width = len(devs) if submesh is not None else 1
+        target = math.ceil(batch_size / width) * width
         losses = []
         for _epoch in range(epochs):
             order = rng.permutation(n) if shuffle else np.arange(n)
-            # fixed-size batches only → one compiled step program; the
-            # ragged tail wraps around (standard TPU static-shape practice)
             for start in range(0, n, batch_size):
                 idx = order[start:start + batch_size]
-                if len(idx) < batch_size:
-                    pad = order[: batch_size - len(idx)]
-                    idx = np.concatenate([idx, pad])
+                if len(idx) < target:
+                    reps = math.ceil((target - len(idx)) / n)
+                    fill = np.concatenate([order] * reps)[: target - len(idx)]
+                    idx = np.concatenate([idx, fill])
                 xb, yb = X[idx], y[idx]
-                if device is not None:
-                    xb, yb = jax.device_put((xb, yb), device)
-                params, opt_state, loss = train_step(
+                if submesh is not None:
+                    xb, yb = M.shard_batch((xb, yb), submesh)
+                elif devs is not None:
+                    xb, yb = jax.device_put((xb, yb), devs[0])
+                params, opt_state, loss = entry.step(
                     params, opt_state, xb, yb)
             losses.append(float(loss))
         return params, losses
@@ -176,10 +255,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             var_keys.append(key)
         return model, gin, var_keys
 
-    def _fit(self, frame, device=None):
+    def _fit(self, frame, devices=None):
         X, y = self._getNumpyFeaturesAndLabels(frame)
         model, gin, var_keys = self._ingest()
-        params, _losses = self._train_one(gin, X, y, device=device)
+        # fresh gin per call → a cached step could never be re-hit; don't
+        # let it pin this weight set or evict fitMultiple's shared entry
+        params, _losses = self._train_one(gin, X, y, devices=devices,
+                                          cache_step=False)
         path = self._save_trained(model, var_keys, params)
         return self._make_transformer(path)
 
@@ -192,7 +274,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                   self.imageLoader):
             if p not in conf._paramMap:
                 continue
-            new, old = conf._paramMap[p], self._paramMap.get(p)
+            # compare against the effective base value (explicit OR default):
+            # a paramMap entry equal to an inherited default is NOT an
+            # override and must not force the expensive private _fit
+            new = conf._paramMap[p]
+            old = self.getOrDefault(p) if self.isDefined(p) else None
             try:
                 if not bool(new == old):
                     return True
@@ -233,9 +319,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 if i in private:
                     # private trials stay on their slice too, or they'd
                     # collide with pinned trials on the default device
-                    return confs[i]._fit(frame, device=slice_devs[0])
+                    return confs[i]._fit(frame, devices=slice_devs)
                 params, _losses = self._train_one(gin, X, y, pm,
-                                                  device=slice_devs[0])
+                                                  devices=slice_devs)
                 with self._save_lock:  # keras model object is shared
                     path = self._save_trained(model, var_keys, params)
                 return confs[i]._make_transformer(path)

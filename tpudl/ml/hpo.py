@@ -10,10 +10,13 @@ pool — JAX dispatch is thread-safe and XLA execution releases the GIL,
 so trials on distinct devices genuinely overlap — and results are
 yielded in COMPLETION order (the upstream CrossValidator contract).
 
-The dataset is shared host RAM; each trial places its batches on its own
-slice. No collect, no broadcast, no per-task recompile of the ingested
-model (trials re-jit per device, which on same-shape trials is an XLA
-cache hit per device).
+The dataset is shared host RAM; each trial shards its batches over its
+own slice (a width-1 slice pins to the device; a wider slice is a
+data-parallel sub-mesh, so every device in the slice works). No collect,
+no broadcast, no per-trial recompile: the estimator shares ONE jitted
+train step across trials (see KerasImageFileEstimator._get_step — the
+learning rate is dynamic inside opt_state), so same-shape trials trace
+once and compile once per distinct device slice.
 """
 
 from __future__ import annotations
@@ -32,11 +35,18 @@ def device_slices(n_trials: int, devices: Sequence | None = None,
     """Carve the device pool into one slice per concurrently-running
     trial. With fewer trials than devices, slices are widened (extra
     devices would idle); with more trials than devices, slices are one
-    device each and the pool throttles concurrency."""
+    device each and the pool throttles concurrency. A non-dividing pool
+    spreads the remainder: 8 devices / 3 trials → widths 3, 3, 2 — no
+    device is dropped."""
     devs = list(devices) if devices is not None else jax.devices()
     n_slices = max(1, min(n_trials, len(devs)))
-    width = len(devs) // n_slices
-    return [devs[i * width:(i + 1) * width] for i in range(n_slices)]
+    width, rem = divmod(len(devs), n_slices)
+    slices, at = [], 0
+    for i in range(n_slices):
+        w = width + (1 if i < rem else 0)
+        slices.append(devs[at:at + w])
+        at += w
+    return slices
 
 
 class TrialScheduler:

@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["LOSSES", "OPTIMIZERS", "get_loss", "get_optimizer"]
+__all__ = ["LOSSES", "OPTIMIZERS", "get_loss", "get_optimizer",
+           "get_optimizer_dynamic"]
 
 _EPS = 1e-7  # keras backend epsilon
 
@@ -67,10 +68,9 @@ _OPT_DEFAULT_LR = {
 }
 
 
-def _make_optimizer(name: str, learning_rate: float | None):
+def _opt_factory(name: str):
     import optax
 
-    lr = learning_rate if learning_rate is not None else _OPT_DEFAULT_LR[name]
     return {
         "sgd": optax.sgd,
         "adam": optax.adam,
@@ -79,7 +79,12 @@ def _make_optimizer(name: str, learning_rate: float | None):
         "adadelta": optax.adadelta,
         "adamax": optax.adamax,
         "nadam": optax.nadam,
-    }[name](lr)
+    }[name]
+
+
+def _make_optimizer(name: str, learning_rate: float | None):
+    lr = learning_rate if learning_rate is not None else _OPT_DEFAULT_LR[name]
+    return _opt_factory(name)(lr)
 
 
 OPTIMIZERS = frozenset(_OPT_DEFAULT_LR)
@@ -95,3 +100,19 @@ def get_optimizer(name: str, learning_rate: float | None = None):
     if name not in OPTIMIZERS:
         raise KeyError(f"unknown optimizer {name!r}; one of {sorted(OPTIMIZERS)}")
     return _make_optimizer(name, learning_rate)
+
+
+def get_optimizer_dynamic(name: str):
+    """Optimizer whose learning rate lives in ``opt_state.hyperparams``
+    (optax.inject_hyperparams) instead of the update closure — so ONE
+    compiled train step serves every learning rate in an HPO sweep
+    (override ``opt_state.hyperparams['learning_rate']`` after init).
+
+    Returns ``(optimizer, default_lr)``."""
+    import optax
+
+    if name not in OPTIMIZERS:
+        raise KeyError(f"unknown optimizer {name!r}; one of {sorted(OPTIMIZERS)}")
+    default_lr = _OPT_DEFAULT_LR[name]
+    return (optax.inject_hyperparams(_opt_factory(name))(
+        learning_rate=default_lr), default_lr)

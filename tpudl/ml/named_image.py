@@ -80,7 +80,10 @@ def load_named_params(model_name: str, weights: str = "random") -> dict:
     elif weights.endswith(".npz"):
         from tpudl.zoo.convert import load_params_npz
 
-        params = load_params_npz(weights)
+        # an explicitly-named artifact is the user vouching for the file,
+        # so legacy pickled layouts stay loadable here; only the
+        # TPUDL_WEIGHTS_DIR auto-discovery path above refuses them
+        params = load_params_npz(weights, allow_legacy_pickle=True)
     else:
         from tpudl.zoo.convert import load_keras_model, params_from_keras
 

@@ -115,21 +115,35 @@ def save_params_npz(params: dict, path: str) -> str:
     return path
 
 
-def load_params_npz(path: str) -> dict:
-    """Load a .npz param artifact (flat ``layer/param`` layout, or the
-    legacy single pickled-dict layout)."""
-    with np.load(path, allow_pickle=True) as z:
-        if z.files == ["params"]:  # legacy pickled layout
-            return z["params"].item()
-        params: dict[str, dict] = {}
-        for key in z.files:
-            layer, _, pname = key.rpartition("/")
-            if not layer:
-                raise ValueError(
-                    f"{path}: unrecognized npz key {key!r} (expected "
-                    "'layer/param' entries)")
-            params.setdefault(layer, {})[pname] = z[key]
-        return params
+def load_params_npz(path: str, allow_legacy_pickle: bool = False) -> dict:
+    """Load a .npz param artifact (flat ``layer/param`` layout; the legacy
+    single pickled-dict layout only with ``allow_legacy_pickle=True``).
+
+    Always opens with ``allow_pickle=False`` so a trojaned artifact in an
+    auto-discovered weights dir (``$TPUDL_WEIGHTS_DIR``) cannot execute
+    code. The legacy pickled layout is inherently code-executing to load,
+    so it is refused unless the caller explicitly opts in for a trusted
+    file — the auto-discovery path never does."""
+    with np.load(path, allow_pickle=False) as z:
+        files = z.files
+        if files != ["params"]:
+            params: dict[str, dict] = {}
+            for key in files:
+                layer, _, pname = key.rpartition("/")
+                if not layer:
+                    raise ValueError(
+                        f"{path}: unrecognized npz key {key!r} (expected "
+                        "'layer/param' entries)")
+                params.setdefault(layer, {})[pname] = z[key]
+            return params
+    if not allow_legacy_pickle:
+        raise ValueError(
+            f"{path} uses the legacy pickled single-'params' layout, which "
+            "requires executing pickle opcodes to load; re-save it with "
+            "save_params_npz, or pass allow_legacy_pickle=True only for a "
+            "trusted file")
+    with np.load(path, allow_pickle=True) as z:  # legacy pickled layout
+        return z["params"].item()
 
 
 def save_named_params(name: str, path: str, weights: str = "imagenet") -> str:
