@@ -294,11 +294,24 @@ class TestEstimatorParallelHPO:
         pms = [{est.kerasFitParams: {"batch_size": 4, "epochs": 1,
                                      "learning_rate": lr}}
                for lr in (1e-2, 3e-3, 1e-3, 3e-4)]
+        seen = []
+        orig = est._get_step
+
+        def spy(*a, **kw):
+            e = orig(*a, **kw)
+            seen.append(e)
+            return e
+
+        est._get_step = spy
         got = dict(est.fitMultiple(frame, pms))
         assert sorted(got) == [0, 1, 2, 3]
-        entries = list(est._step_cache.values())
+        entries = {id(e): e for e in seen}
         assert len(entries) == 1, (
-            f"{len(entries)} step-cache entries for identical (graph, "
+            f"{len(entries)} distinct step entries for identical (graph, "
             "loss, optimizer) trials")
-        assert entries[0].n_traces() == 1, (
-            f"step traced {entries[0].n_traces()}× for 4 same-shape trials")
+        (entry,) = entries.values()
+        assert entry.n_traces() == 1, (
+            f"step traced {entry.n_traces()}× for 4 same-shape trials")
+        # entries are scoped to the fitMultiple call: nothing may stay
+        # pinned (each holds the compiled step's closure over the weights)
+        assert not est._step_cache, "step cache retained entries after sweep"

@@ -229,12 +229,15 @@ class TFInputGraph:
 # -- loader plumbing -------------------------------------------------------
 def _log_v1_fallback(saved_model_dir, err):
     """A genuine v1 failure (wrong tag set, corrupt proto, OOM) must stay
-    visible even when the v2 loader then succeeds with different
+    discoverable even when the v2 loader then succeeds with different
     signatures — otherwise a misrouted TF1 artifact surfaces only a
-    confusing v2-side error."""
+    confusing v2-side error. INFO, not WARNING: every healthy TF2
+    object-graph load also routes through this fallback, so a WARNING
+    here would just train users to ignore it. When the v2 loader fails
+    too, Python's exception chaining surfaces this v1 error in full."""
     import logging
 
-    logging.getLogger("tpudl.ingest").warning(
+    logging.getLogger("tpudl.ingest").info(
         "TF1 SavedModel load of %r failed (%s: %s); retrying with the v2 "
         "object-graph loader", saved_model_dir, type(err).__name__, err)
 

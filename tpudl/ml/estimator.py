@@ -196,15 +196,18 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             raise ValueError("cannot fit on an empty frame (0 images)")
         # fixed-size batches only → one compiled step program; the ragged
         # tail wraps around (standard TPU static-shape practice). On a
-        # sub-mesh the batch is additionally padded (by wrap-around) to a
-        # multiple of the slice width so it shards evenly.
+        # sub-mesh batch_size is rounded UP to a multiple of the slice
+        # width and batches stride by that size, drawing FRESH rows — not
+        # per-batch row duplication, which would double-weight the padding
+        # rows in the mean loss and make identical hyperparams train
+        # differently on different-width slices.
         width = len(devs) if submesh is not None else 1
         target = math.ceil(batch_size / width) * width
         losses = []
         for _epoch in range(epochs):
             order = rng.permutation(n) if shuffle else np.arange(n)
-            for start in range(0, n, batch_size):
-                idx = order[start:start + batch_size]
+            for start in range(0, n, target):
+                idx = order[start:start + target]
                 if len(idx) < target:
                     reps = math.ceil((target - len(idx)) / n)
                     fill = np.concatenate([order] * reps)[: target - len(idx)]
@@ -326,6 +329,16 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     path = self._save_trained(model, var_keys, params)
                 return confs[i]._make_transformer(path)
 
-            yield from sched.run(paramMaps, trial)
+            try:
+                yield from sched.run(paramMaps, trial)
+            finally:
+                # entries are keyed by this call's gin and can never be
+                # re-hit afterwards; dropping them releases the compiled
+                # step's closure over the full weight set (a long-lived
+                # estimator must not pin one weight set per sweep)
+                with self._step_lock:
+                    for k in [k for k, e in self._step_cache.items()
+                              if e.gin is gin]:
+                        del self._step_cache[k]
 
         return gen()
