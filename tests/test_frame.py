@@ -111,3 +111,91 @@ def test_sql_duplicate_alias_raises():
     t = Frame({"x": np.arange(3.0), "y": np.arange(3.0)})
     with pytest.raises(ValueError):
         sql("SELECT x AS a, y AS a FROM t", {"t": t})
+
+
+class TestPrefetchInfeed:
+    """Double-buffered infeed (VERDICT round 2, missing #3 / next #1c):
+    batch k+1 is packed and transferred on a worker thread while batch k
+    computes."""
+
+    def test_prefetch_matches_serial_jitted(self, mesh8, rng):
+        import jax
+
+        x = rng.normal(size=(37, 4)).astype(np.float32)
+        f = Frame({"x": x})
+        jfn = jax.jit(lambda b: (b * 2).sum(axis=1))
+        a = f.map_batches(jfn, ["x"], ["y"], batch_size=8, prefetch=True)
+        b = f.map_batches(jfn, ["x"], ["y"], batch_size=8, prefetch=False)
+        np.testing.assert_allclose(a["y"], b["y"], rtol=1e-6)
+        c = f.map_batches(jfn, ["x"], ["y"], batch_size=8, mesh=mesh8)
+        np.testing.assert_allclose(np.asarray(c["y"]), b["y"], rtol=1e-6)
+
+    def test_pack_runs_on_infeed_thread(self, rng):
+        import threading
+
+        import jax
+
+        x = rng.normal(size=(32, 3)).astype(np.float32)
+        threads = []
+
+        def spy_pack(sl):
+            threads.append(threading.current_thread().name)
+            return np.asarray(sl)
+
+        out = Frame({"x": x}).map_batches(
+            jax.jit(lambda b: b + 1), ["x"], ["y"], batch_size=8,
+            pack=spy_pack, prefetch=True)
+        np.testing.assert_allclose(np.stack(list(out["y"])), x + 1,
+                                   rtol=1e-6)
+        assert len(threads) == 4
+        assert all(t.startswith("tpudl-infeed") for t in threads), threads
+
+    def test_next_batch_prepares_during_compute(self, rng):
+        """The point of the double buffer: prepare(k+1) must run WHILE
+        fn(k) is executing. fn(batch 0) blocks until the worker reports
+        batch 1's pack started; a serial executor would time out."""
+        import threading
+
+        started = [threading.Event() for _ in range(4)]
+
+        def spy_pack(sl):
+            i = int(np.asarray(sl)[0, 0])
+            started[i].set()
+            return np.asarray(sl)
+
+        def fn(b):
+            i = int(np.asarray(b)[0, 0])
+            if i + 1 < len(started):
+                assert started[i + 1].wait(timeout=10), (
+                    f"batch {i + 1} was not being prepared while batch "
+                    f"{i} computed — infeed is serial")
+            return b * 2
+
+        x = np.repeat(np.arange(4, dtype=np.float32), 8)[:, None]
+        out = Frame({"x": x}).map_batches(fn, ["x"], ["y"], batch_size=8,
+                                          pack=spy_pack, prefetch=True)
+        np.testing.assert_allclose(np.stack(list(out["y"])), x * 2)
+
+    def test_env_kill_switch(self, rng, monkeypatch):
+        import threading
+
+        monkeypatch.setenv("TPUDL_FRAME_PREFETCH", "0")
+        names = []
+
+        def spy_pack(sl):
+            names.append(threading.current_thread().name)
+            return np.asarray(sl)
+
+        x = rng.normal(size=(16, 2)).astype(np.float32)
+        Frame({"x": x}).map_batches(lambda b: b, ["x"], ["y"],
+                                    batch_size=8, pack=spy_pack,
+                                    prefetch=True)
+        assert all(not t.startswith("tpudl-infeed") for t in names)
+
+    def test_check_finite_raises_through_prefetch(self, mesh8):
+        x = np.ones((16, 2), dtype=np.float32)
+        x[9, 1] = np.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            Frame({"x": x}).map_batches(
+                lambda b: b, ["x"], ["y"], batch_size=4, mesh=mesh8,
+                check_finite=True)

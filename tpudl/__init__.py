@@ -29,6 +29,8 @@ _LAZY = {
     "PipelineModel": "tpudl.ml",
     "TFInputGraph": "tpudl.ingest",
     "KerasImageFileEstimator": "tpudl.ml.estimator",
+    "ParamGridBuilder": "tpudl.ml.tuning",
+    "CrossValidator": "tpudl.ml.tuning",
     "LogisticRegression": "tpudl.ml",
     "registerKerasImageUDF": "tpudl.udf.keras_image_model",
     "GraphFunction": "tpudl.ingest",

@@ -14,14 +14,103 @@ path (ref: sparkdl graph/tensorframes_udf.py, tf_image.py:_transform).
 
 from __future__ import annotations
 
+import os
 from collections.abc import Callable, Iterator, Mapping, Sequence
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-__all__ = ["Frame", "concat"]
+__all__ = ["Frame", "LazyColumn", "concat"]
+
+
+class LazyColumn:
+    """A deferred column: elements materialize per access, so host RAM in
+    ``map_batches`` is O(batch) no matter the row count — the lazy input
+    plane replacing the reference's ``sc.binaryFiles`` partitioned RDD
+    (ref: sparkdl imageIO.py filesToDF ~L200; SURVEY.md §5.8). Concrete
+    sources implement ``__len__`` and ``_get(indices) -> object ndarray``
+    (see tpudl.image.imageIO.LazyFileColumn)."""
+
+    dtype = np.dtype(object)
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _get(self, indices: np.ndarray) -> np.ndarray:  # pragma: no cover
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        n = len(self)
+        if isinstance(idx, slice):
+            return self._get(np.arange(*idx.indices(n)))
+        arr = np.asarray(idx)
+        if arr.ndim == 0:
+            return self._get(np.array([int(arr)]))[0]
+        if arr.dtype == bool:
+            arr = np.nonzero(arr)[0]
+        return self._get(arr.astype(np.intp))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def subset(self, indices) -> "LazyColumn":
+        """A LAZY row-subset view (used by Frame.filter_rows/dropna):
+        keeps only the index mapping, so filtering a million-file column
+        costs O(rows) indices, not O(dataset) decoded payloads."""
+        return _SubsetLazyColumn(self, np.asarray(indices, dtype=np.intp))
+
+
+class _SubsetLazyColumn(LazyColumn):
+    def __init__(self, base: LazyColumn, indices: np.ndarray):
+        self._base = base
+        self._indices = indices
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def _get(self, indices: np.ndarray) -> np.ndarray:
+        return self._base._get(self._indices[indices])
+
+
+class _PrefetchInfeed:
+    """One-deep double-buffered infeed: batch k+1 is packed and
+    host→device-transferred on a worker thread while the main thread
+    dispatches batch k's compute (SURVEY.md §7.3 "double-buffered
+    infeed"). One deep is enough — deeper queues only add host RAM and
+    device-buffer pressure without more overlap."""
+
+    def __init__(self, prepare: Callable, spans: Sequence[tuple[int, int]]):
+        self._prepare = prepare
+        self._spans = spans
+        self._ex = ThreadPoolExecutor(max_workers=1,
+                                      thread_name_prefix="tpudl-infeed")
+        self._next = (self._ex.submit(prepare, *spans[0]) if spans else None)
+
+    def get(self, i: int):
+        try:
+            out = self._next.result()
+        except BaseException:
+            self._ex.shutdown(wait=False)
+            raise
+        if i + 1 < len(self._spans):
+            self._next = self._ex.submit(self._prepare, *self._spans[i + 1])
+        else:
+            self._ex.shutdown(wait=False)
+        return out
+
+    def close(self):
+        """Release the worker even when the consumer loop unwinds early
+        (fn raised mid-batch) — otherwise the in-flight prepare keeps
+        reading/transferring and the non-daemon thread lingers."""
+        if self._next is not None:
+            self._next.cancel()
+        self._ex.shutdown(wait=False)
 
 
 def _as_column(values) -> np.ndarray:
+    if isinstance(values, LazyColumn):
+        return values  # deferred source; materializes per access
     if isinstance(values, np.ndarray):
         return values
     values = list(values)
@@ -104,9 +193,18 @@ class Frame:
 
     def filter_rows(self, mask) -> "Frame":
         mask = np.asarray(mask, dtype=bool)
-        return Frame({k: v[mask] for k, v in self._cols.items()}, self.num_partitions)
+        idx = np.nonzero(mask)[0]
+        return Frame(
+            {k: (v.subset(idx) if isinstance(v, LazyColumn) else v[mask])
+             for k, v in self._cols.items()},
+            self.num_partitions)
 
     def dropna(self, subset: Sequence[str] | None = None) -> "Frame":
+        """Drop rows with None/NaN in ``subset`` (default: all columns).
+        On a LazyColumn the null scan streams row-by-row (O(1) held
+        payloads; each row is decoded once for the scan) and the result
+        keeps a lazy subset VIEW — filtering a huge readImages() frame
+        stays O(batch) in host RAM."""
         names = list(subset) if subset else self.columns
         mask = np.ones(self._n, dtype=bool)
         for n in names:
@@ -148,6 +246,7 @@ class Frame:
         mesh=None,
         pack: Callable | None = None,
         check_finite: bool = False,
+        prefetch: bool | None = None,
     ) -> "Frame":
         """Run ``fn`` over the frame in device-sized batches; append outputs.
 
@@ -162,12 +261,25 @@ class Frame:
         ``batch_size`` defaults to the frame's ``num_partitions`` hint
         (``ceil(rows / num_partitions)`` — the Spark-side meaning of a
         partition as the unit of executor dispatch), else 256.
+
+        ``prefetch`` enables the double-buffered infeed (SURVEY.md §7.3):
+        a one-deep worker thread packs AND host→device-transfers batch
+        k+1 while batch k computes, so decode/stack work and the wire
+        transfer ride under device compute instead of serializing with
+        it. Default: on when ``fn`` is a jitted/device function (or a
+        mesh is given), off for plain host fns (whose inputs must stay
+        numpy). ``TPUDL_FRAME_PREFETCH=0`` force-disables (bench A/B).
         """
         if batch_size is None:
             if self.num_partitions:
                 batch_size = max(1, -(-self._n // int(self.num_partitions)))
             else:
                 batch_size = 256
+        device_fn = mesh is not None or hasattr(fn, "lower")  # jitted?
+        if prefetch is None:
+            prefetch = device_fn
+        if os.environ.get("TPUDL_FRAME_PREFETCH", "1") == "0":
+            prefetch = False
         if mesh is not None:
             from tpudl import mesh as M  # jax import only on the mesh path
 
@@ -175,13 +287,12 @@ class Frame:
         missing = [c for c in input_cols if c not in self._cols]
         if missing:
             raise KeyError(f"unknown input columns {missing}")
-        outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
-        acc: list[list] = [[] for _ in output_cols]  # device-resident results
-        segs: list[tuple[int, int]] = []  # (padded_len, n_pad) per batch
-        pending: list[tuple[tuple, int]] = []
-        mode = None  # "acc" (fetch once at end) or "window" (bounded drain)
-        est_batches = max(1, -(-self._n // max(1, batch_size)))
-        for start, stop in self.iter_batches(batch_size):
+
+        def prepare(start, stop):
+            """Pack (and, on the prefetch path, transfer) one batch.
+            Runs on the worker thread when prefetching: jax dispatch is
+            thread-safe and transfers release the GIL, so this overlaps
+            the main thread's compute dispatch."""
             packed = []
             for c in input_cols:
                 sl = self._cols[c][start:stop]
@@ -202,36 +313,63 @@ class Frame:
                 padded = [M.pad_batch(arr, multiple) for arr in packed]
                 n_pad = padded[0][1] if padded else 0
                 packed = [M.shard_batch(p, mesh) for p, _ in padded]
-            # (mesh=None: host arrays go straight into the jitted fn — the
-            # runtime's own arg transfer pipelines far better than an
-            # explicit device_put through tunneled backends)
-            result = fn(*packed)
-            if not isinstance(result, (tuple, list)):
-                result = (result,)
-            if len(result) != len(output_cols):
-                raise ValueError(
-                    f"fn returned {len(result)} outputs, expected {len(output_cols)}"
-                )
-            if mode is None:
-                mode = _pick_fetch_mode(result, est_batches)
-            if mode == "acc":
-                # Keep results device-resident and fetch ONCE per column at
-                # the end: device→host fetch has a large fixed cost per
-                # round-trip on tunneled/remote PJRT backends, so per-batch
-                # fetching serializes the pipeline (round-1 bottleneck).
-                for i, r in enumerate(result):
-                    acc[i].append(r)
-                segs.append((stop - start + n_pad, n_pad))
-            else:
-                # Large outputs (e.g. outputMode='image'): bounded window so
-                # device memory stays O(window · batch), with the host copy
-                # started at dispatch so it overlaps later batches' compute.
-                for r in result:
-                    if hasattr(r, "copy_to_host_async"):
-                        r.copy_to_host_async()
-                pending.append((tuple(result), n_pad))
-                if len(pending) > _PIPELINE_WINDOW:
-                    _drain(pending.pop(0), outputs)
+                if prefetch:
+                    import jax
+
+                    jax.block_until_ready(packed)  # force the copy HERE
+            # mesh=None: host arrays go straight into the jitted fn even
+            # when prefetching — the runtime's own arg transfer pipelines
+            # far better than an explicit device_put on tunneled/remote
+            # backends (measured: prefetch-with-device_put was SLOWER
+            # than the serial fn-arg route through the tunnel). The
+            # prefetch win here is the pack/decode work riding under
+            # compute; the transfer stays on the dispatch path.
+            return packed, n_pad
+
+        outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
+        acc: list[list] = [[] for _ in output_cols]  # device-resident results
+        segs: list[tuple[int, int]] = []  # (padded_len, n_pad) per batch
+        pending: list[tuple[tuple, int]] = []
+        mode = None  # "acc" (fetch once at end) or "window" (bounded drain)
+        est_batches = max(1, -(-self._n // max(1, batch_size)))
+        spans = list(self.iter_batches(batch_size))
+        infeed = _PrefetchInfeed(prepare, spans) if prefetch else None
+        try:
+            for bi, (start, stop) in enumerate(spans):
+                packed, n_pad = (infeed.get(bi) if infeed
+                                 else prepare(start, stop))
+                result = fn(*packed)
+                if not isinstance(result, (tuple, list)):
+                    result = (result,)
+                if len(result) != len(output_cols):
+                    raise ValueError(
+                        f"fn returned {len(result)} outputs, expected "
+                        f"{len(output_cols)}")
+                if mode is None:
+                    mode = _pick_fetch_mode(result, est_batches)
+                if mode == "acc":
+                    # Keep results device-resident and fetch ONCE per column
+                    # at the end: device→host fetch has a large fixed cost
+                    # per round-trip on tunneled/remote PJRT backends, so
+                    # per-batch fetching serializes the pipeline (round-1
+                    # bottleneck).
+                    for i, r in enumerate(result):
+                        acc[i].append(r)
+                    segs.append((stop - start + n_pad, n_pad))
+                else:
+                    # Large outputs (e.g. outputMode='image'): bounded
+                    # window so device memory stays O(window · batch), with
+                    # the host copy started at dispatch so it overlaps later
+                    # batches' compute.
+                    for r in result:
+                        if hasattr(r, "copy_to_host_async"):
+                            r.copy_to_host_async()
+                    pending.append((tuple(result), n_pad))
+                    if len(pending) > _PIPELINE_WINDOW:
+                        _drain(pending.pop(0), outputs)
+        finally:
+            if infeed is not None:
+                infeed.close()
         while pending:
             _drain(pending.pop(0), outputs)
         if mode == "acc":
@@ -310,7 +448,9 @@ def concat(frames: Sequence[Frame]) -> Frame:
             merged = np.empty(sum(len(c) for c in cols), dtype=object)
             i = 0
             for c in cols:
-                merged[i : i + len(c)] = c
+                # a LazyColumn materializes here: concat is an explicit
+                # whole-frame operation, not the streaming path
+                merged[i : i + len(c)] = c[:] if isinstance(c, LazyColumn) else c
                 i += len(c)
             out[n] = merged
         else:

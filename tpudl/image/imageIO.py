@@ -24,6 +24,8 @@ from typing import Callable, Iterable
 
 import numpy as np
 
+from tpudl.frame.frame import LazyColumn
+
 try:  # PIL is the decode substrate, mirroring the reference's Python path
     from PIL import Image
 except ImportError:  # pragma: no cover
@@ -42,6 +44,7 @@ __all__ = [
     "resizeImage",
     "filesToFrame",
     "readImagesWithCustomFn",
+    "LazyFileColumn",
     "SPARK_MODE",
 ]
 
@@ -342,6 +345,39 @@ def createResizeImageUDF(size: tuple[int, int]) -> Callable[[dict], dict]:
     return _resize
 
 
+class LazyFileColumn(LazyColumn):
+    """File-backed :class:`tpudl.frame.frame.LazyColumn`: stores only the
+    paths; bytes are read (and optionally transformed) per accessed batch,
+    so host RAM is O(batch) at any dataset size — the streaming rebuild of
+    the reference's lazy/partitioned ``sc.binaryFiles`` RDD (ref: sparkdl
+    imageIO.py filesToDF ~L200). ``reads`` counts file reads, so tests can
+    assert laziness directly."""
+
+    def __init__(self, paths, transform: Callable | None = None):
+        self._paths = np.asarray(list(paths), dtype=object)
+        self._transform = transform
+        self.reads = 0
+
+    def __len__(self) -> int:
+        return len(self._paths)
+
+    def _get(self, indices: np.ndarray) -> np.ndarray:
+        out = np.empty(len(indices), dtype=object)
+        for j, i in enumerate(indices):
+            p = self._paths[i]
+            with open(p, "rb") as f:
+                raw = f.read()
+            self.reads += 1
+            out[j] = self._transform(p, raw) if self._transform else raw
+        return out
+
+    def with_transform(self, transform: Callable) -> "LazyFileColumn":
+        """Same paths, different per-file transform — how readImages
+        derives its lazy decoded column from filesToFrame's byte column
+        without re-listing or re-sharding."""
+        return LazyFileColumn(self._paths, transform)
+
+
 def _listFiles(path: str | Iterable[str]) -> list[str]:
     if isinstance(path, (list, tuple)):
         return [str(p) for p in path]
@@ -354,13 +390,17 @@ def _listFiles(path: str | Iterable[str]) -> list[str]:
 
 
 def filesToFrame(path, numPartitions: int | None = None,
-                 host_sharded: bool = False):
-    """Read raw file bytes into a Frame with columns (filePath, fileData).
+                 host_sharded: bool = False, lazy: bool = True):
+    """A Frame with columns (filePath, fileData) over raw file bytes.
 
     ref: imageIO.filesToDF (~L200) — sc.binaryFiles → DataFrame[filePath,
-    fileData]. ``numPartitions`` is the Frame's partition hint: it sets
+    fileData]. Like ``binaryFiles``, the default is LAZY: ``fileData`` is
+    a :class:`LazyFileColumn` that stores only paths and reads bytes per
+    accessed batch, so host RAM is O(batch) at ImageNet scale
+    (``lazy=False`` reads everything up front for small interactive
+    frames). ``numPartitions`` is the Frame's partition hint: it sets
     ``map_batches``'s default dispatch granularity
-    (``batch_size ≈ rows/numPartitions``). ``host_sharded=True`` reads
+    (``batch_size ≈ rows/numPartitions``). ``host_sharded=True`` keeps
     only THIS host's shard of the file list (tpudl.distributed.host_shard
     — the multi-host input plane replacing Spark partition assignment).
     """
@@ -371,45 +411,64 @@ def filesToFrame(path, numPartitions: int | None = None,
         from tpudl import distributed as D
 
         paths = D.host_shard(paths)
-    datas = []
-    for p in paths:
-        with open(p, "rb") as f:
-            datas.append(f.read())
+    if lazy:
+        data = LazyFileColumn(paths)
+    else:
+        datas = []
+        for p in paths:
+            with open(p, "rb") as f:
+                datas.append(f.read())
+        data = np.array(datas, dtype=object)
     return Frame(
-        {"filePath": np.array(paths, dtype=object), "fileData": np.array(datas, dtype=object)},
+        {"filePath": np.array(paths, dtype=object), "fileData": data},
         num_partitions=numPartitions,
     )
 
 
+def _decode_row(decode_f, origin, raw):
+    """decode_f semantics shared by the eager and lazy read paths
+    (ref: readImagesWithCustomFn ~L220): exceptions/None → None row;
+    ndarray results are wrapped into structs with the file origin."""
+    try:
+        out = decode_f(raw)
+    except Exception:
+        return None
+    if out is None:
+        return None
+    if isinstance(out, dict):
+        out = dict(out)
+        if not out.get("origin"):
+            out["origin"] = origin
+        return out
+    return imageArrayToStruct(np.asarray(out), origin=origin)
+
+
 def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None,
-                           host_sharded: bool = False):
+                           host_sharded: bool = False, lazy: bool = True):
     """Read a directory of images with a custom decode function → Frame["image"].
 
     ref: imageIO.readImagesWithCustomFn (~L220): binaryFiles → decode_f per
     file → image-struct column; undecodable files become None rows.
     ``decode_f`` takes raw bytes and returns an ndarray (H, W, C) **in BGR
-    storage order** or an image struct dict or None.
+    storage order** or an image struct dict or None. Default is LAZY:
+    decode happens per accessed batch (inside ``map_batches``'s prefetch
+    thread on the executor path), so neither raw bytes nor decoded structs
+    for the whole dataset ever sit in host RAM together. Listing and
+    host-sharding are delegated to :func:`filesToFrame` so the byte and
+    image paths can never diverge.
     """
-    frame = filesToFrame(path, numPartitions=numPartition,
-                         host_sharded=host_sharded)
-    structs = []
-    for origin, raw in zip(frame["filePath"], frame["fileData"]):
-        try:
-            out = decode_f(raw)
-        except Exception:
-            out = None
-        if out is None:
-            structs.append(None)
-        elif isinstance(out, dict):
-            out = dict(out)
-            if not out.get("origin"):
-                out["origin"] = origin
-            structs.append(out)
-        else:
-            structs.append(imageArrayToStruct(np.asarray(out), origin=origin))
     from tpudl.frame import Frame
 
-    return Frame({"image": np.array(structs, dtype=object)}, num_partitions=numPartition)
+    files = filesToFrame(path, numPartitions=numPartition,
+                         host_sharded=host_sharded, lazy=lazy)
+    if lazy:
+        col = files["fileData"].with_transform(
+            lambda p, raw: _decode_row(decode_f, p, raw))
+        return Frame({"image": col}, num_partitions=numPartition)
+    structs = [_decode_row(decode_f, origin, raw)
+               for origin, raw in zip(files["filePath"], files["fileData"])]
+    return Frame({"image": np.array(structs, dtype=object)},
+                 num_partitions=numPartition)
 
 
 def readImages(path, numPartition: int | None = None):

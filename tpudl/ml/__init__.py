@@ -16,6 +16,8 @@ from tpudl.ml.pipeline import (Estimator, Model, Pipeline, PipelineModel,
                                Transformer)
 from tpudl.ml.tf_image import TFImageTransformer
 from tpudl.ml.tf_tensor import TFTransformer
+from tpudl.ml.tuning import (CrossValidator, CrossValidatorModel, Evaluator,
+                             FunctionEvaluator, ParamGridBuilder)
 
 __all__ = [
     "DeepImageFeaturizer",
@@ -35,4 +37,9 @@ __all__ = [
     "Param",
     "Params",
     "TypeConverters",
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "Evaluator",
+    "FunctionEvaluator",
 ]
