@@ -1,0 +1,78 @@
+"""Ring attention tests: the sequence-parallel ring must match the dense
+single-device oracle exactly (up to float re-association), causal and
+non-causal, and differentiate end-to-end. Runs on the 8-device simulated
+CPU mesh — the same SPMD program a pod slice would compile."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpudl import mesh as M
+from tpudl.attention import (attention_reference, ring_attention,
+                             shard_sequence)
+
+
+@pytest.fixture(scope="module")
+def ring_mesh():
+    return M.build_mesh()  # (data=8, model=1); ring over the data axis
+
+
+def _qkv(rng, b=2, s=32, h=2, d=8):
+    return tuple(rng.normal(size=(b, s, h, d)).astype(np.float32)
+                 for _ in range(3))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_oracle(self, ring_mesh, rng, causal):
+        q, k, v = _qkv(rng)
+        want = np.asarray(attention_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+        qs, ks, vs = shard_sequence((q, k, v), ring_mesh)
+        got = np.asarray(ring_attention(qs, ks, vs, ring_mesh,
+                                        causal=causal))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_output_stays_sequence_sharded(self, ring_mesh, rng):
+        q, k, v = _qkv(rng)
+        qs, ks, vs = shard_sequence((q, k, v), ring_mesh)
+        out = ring_attention(qs, ks, vs, ring_mesh)
+        assert len(out.sharding.device_set) == 8, (
+            "ring output gathered to one device — sequence parallelism "
+            "lost")
+
+    def test_jit_and_grad(self, ring_mesh, rng):
+        """Long-context training needs d(ring)/dparams: grad through
+        shard_map + ppermute must match the dense oracle's grad."""
+        q, k, v = _qkv(rng, b=1, s=16, h=1, d=4)
+
+        def ring_loss(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, ring_mesh,
+                                          causal=True) ** 2)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        qs, ks, vs = shard_sequence((q, k, v), ring_mesh)
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(qs, ks, vs)
+        g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_uneven_sequence_rejected(self, ring_mesh, rng):
+        q, k, v = _qkv(rng, s=30)  # 30 % 8 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           ring_mesh)
+
+    def test_causal_first_row_attends_self_only(self, ring_mesh, rng):
+        """Position 0 may only see itself: its output must equal v[0]."""
+        q, k, v = _qkv(rng, b=1, s=16, h=1, d=4)
+        qs, ks, vs = shard_sequence((q, k, v), ring_mesh)
+        out = np.asarray(ring_attention(qs, ks, vs, ring_mesh, causal=True))
+        np.testing.assert_allclose(out[0, 0], v[0, 0], rtol=1e-5,
+                                   atol=1e-5)

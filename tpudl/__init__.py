@@ -35,6 +35,9 @@ _LAZY = {
     "registerKerasImageUDF": "tpudl.udf.keras_image_model",
     "GraphFunction": "tpudl.ingest",
     "IsolatedSession": "tpudl.ingest",
+    # long-context / sequence parallelism (TPU-native addition)
+    "ring_attention": "tpudl.attention",
+    "shard_sequence": "tpudl.attention",
 }
 
 __all__ = ["__version__", *_LAZY]

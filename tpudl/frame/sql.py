@@ -4,18 +4,24 @@ The reference registers Keras models as Spark SQL UDFs and users write
 ``SELECT my_udf(image) FROM images`` (ref: sparkdl udf/keras_image_model.py
 ~L30, graph/tensorframes_udf.py ~L20; SURVEY.md §3.4). We are explicitly
 NOT a query engine (SURVEY.md §7.1 item 3), so this module implements only
-the projection shape that contract needs:
+the shapes that contract and its surrounding examples need:
 
-    SELECT <item> [, <item>...] FROM <table> [LIMIT n]
-    item := col | fn(col) | fn(col) AS alias
+    SELECT <item> [, <item>...] FROM <table>
+        [WHERE <pred> [AND <pred>...]] [LIMIT n]
+    item := * | col | fn(col) | col AS alias | fn(col) AS alias
+    pred := col <op> literal | col IS [NOT] NULL
+    op   := = | != | <> | < | <= | > | >=      literal := number | 'text'
 
 Registered UDFs come from :mod:`tpudl.udf.registry`; execution of a model
-UDF is a batched jitted call, not per-row Python.
+UDF is a batched jitted call, not per-row Python. WHERE runs before the
+UDF projection, so filtered rows are never featurized.
 """
 
 from __future__ import annotations
 
 import re
+
+import numpy as np
 
 from tpudl.frame.frame import Frame
 
@@ -23,6 +29,7 @@ __all__ = ["sql"]
 
 _SELECT_RE = re.compile(
     r"^\s*select\s+(?P<items>.+?)\s+from\s+(?P<table>\w+)"
+    r"(?:\s+where\s+(?P<where>.+?))?"
     r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
@@ -31,18 +38,25 @@ _ITEM_RE = re.compile(
     r"(?:\s+as\s+(?P<alias>\w+))?\s*$",
     re.IGNORECASE,
 )
+_CMP_RE = re.compile(
+    r"^\s*(?P<col>\w+)\s*(?P<op><=|>=|!=|<>|=|<|>)\s*"
+    r"(?P<lit>-?\d+(?:\.\d+)?|'[^']*')\s*$")
+_NULL_RE = re.compile(
+    r"^\s*(?P<col>\w+)\s+is\s+(?P<neg>not\s+)?null\s*$", re.IGNORECASE)
 
 
 def sql(query: str, tables: dict[str, Frame]) -> Frame:
     m = _SELECT_RE.match(query)
     if not m:
         raise ValueError(
-            f"unsupported SQL (only 'SELECT items FROM table [LIMIT n]'): {query!r}"
-        )
+            "unsupported SQL (only 'SELECT items FROM table [WHERE preds] "
+            f"[LIMIT n]'): {query!r}")
     table = m.group("table")
     if table not in tables:
         raise KeyError(f"unknown table {table!r}; registered: {sorted(tables)}")
     frame = tables[table]
+    if m.group("where"):
+        frame = frame.filter_rows(_where_mask(frame, m.group("where")))
     limit = m.group("limit")
     if limit is not None:
         frame = frame.limit(int(limit))
@@ -50,7 +64,11 @@ def sql(query: str, tables: dict[str, Frame]) -> Frame:
     out: dict[str, object] = {}
     for raw in _split_items(m.group("items")):
         if raw == "*":
-            raise ValueError("SELECT * not supported; name columns explicitly")
+            for col in frame.columns:
+                if col in out:
+                    raise ValueError(f"duplicate output column {col!r}")
+                out[col] = frame[col]
+            continue
         im = _ITEM_RE.match(raw)
         if not im:
             raise ValueError(f"unsupported select item: {raw!r}")
@@ -70,6 +88,69 @@ def sql(query: str, tables: dict[str, Frame]) -> Frame:
             result = udf(frame.select(arg).with_column_renamed(arg, udf.input_col))
             out[name] = result[udf.output_col]
     return Frame(out)
+
+
+# split on AND only OUTSIDE single-quoted literals (even-quote lookahead)
+_AND_SPLIT_RE = re.compile(
+    r"\s+and\s+(?=(?:[^']*'[^']*')*[^']*$)", re.IGNORECASE)
+
+
+def _where_mask(frame: Frame, where: str) -> np.ndarray:
+    """AND-conjunction of simple predicates → boolean row mask.
+
+    NULL semantics follow SQL three-valued logic for both column kinds:
+    object ``None`` and float ``NaN`` rows fail EVERY comparison
+    (including ``!=``) and are selected only by ``IS NULL``."""
+    mask = np.ones(len(frame), dtype=bool)
+    for pred in _AND_SPLIT_RE.split(where.strip()):
+        nm = _NULL_RE.match(pred)
+        if nm:
+            col = _col(frame, nm.group("col"))
+            isnull = np.array([v is None for v in col], dtype=bool) \
+                if col.dtype == object else (
+                    np.isnan(col) if np.issubdtype(col.dtype, np.floating)
+                    else np.zeros(len(frame), dtype=bool))
+            mask &= ~isnull if nm.group("neg") else isnull
+            continue
+        cm = _CMP_RE.match(pred)
+        if not cm:
+            raise ValueError(
+                f"unsupported WHERE predicate {pred!r} (use col <op> "
+                "literal or col IS [NOT] NULL)")
+        col = _col(frame, cm.group("col"))
+        lit_raw = cm.group("lit")
+        lit = lit_raw[1:-1] if lit_raw.startswith("'") else float(lit_raw)
+        op = cm.group("op")
+        if col.dtype == object:
+            mask &= np.array(
+                [False if v is None else bool(_cmp(v, op, lit))
+                 for v in col], dtype=bool)
+        else:
+            res = np.asarray(_cmp(col, op, lit), dtype=bool)
+            if np.issubdtype(col.dtype, np.floating):
+                res &= ~np.isnan(col)  # NaN fails != too, not just ==/<
+            mask &= res
+    return mask
+
+
+def _col(frame: Frame, name: str) -> np.ndarray:
+    if name not in frame:
+        raise KeyError(f"unknown column {name!r}; have {frame.columns}")
+    return frame[name]
+
+
+def _cmp(a, op: str, b):
+    if op == "=":
+        return a == b
+    if op in ("!=", "<>"):
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    return a >= b
 
 
 def _split_items(items: str) -> list[str]:
