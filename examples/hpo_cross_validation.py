@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Hyperparameter search: ParamGridBuilder + CrossValidator over
+KerasImageFileEstimator (ref: keras_image_file_estimator.py docstring
+usage) — trials run CONCURRENTLY on device slices, models are consumed
+in completion order, the best paramMap is refit on the full data.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tpudl.frame import Frame
+from tpudl.ml import (CrossValidator, FunctionEvaluator,
+                      KerasImageFileEstimator, ParamGridBuilder)
+from tpudl import mesh as M
+
+
+def accuracy(frame):
+    p = np.stack([np.asarray(v) for v in frame["pred"]])
+    y = np.stack([np.asarray(v) for v in frame["label"]])
+    return float(np.mean(p.argmax(1) == y.argmax(1)))
+
+
+def main(uris, labels, model_file, loader):
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="pred", labelCol="label",
+        imageLoader=loader, modelFile=model_file,
+        kerasOptimizer="adam", kerasLoss="categorical_crossentropy",
+        mesh=M.build_mesh())
+    grid = (ParamGridBuilder()
+            .addGrid(KerasImageFileEstimator.kerasFitParams,
+                     [{"batch_size": 32, "epochs": 4, "learning_rate": lr}
+                      for lr in (1e-2, 1e-3, 1e-4)])
+            .build())
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid,
+                        evaluator=FunctionEvaluator(accuracy), numFolds=3)
+    model = cv.fit(Frame({"uri": uris, "label": labels}))
+    print("avg metrics per grid point:", model.avgMetrics)
+    return model.bestModel

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Long-context sequence parallelism: ring attention over the mesh.
+
+A sequence too large for one chip's HBM is sharded over the data axis;
+K/V blocks rotate around the ring on ICI (lax.ppermute) with flash-style
+online softmax — no chip ever holds the full sequence or the full score
+matrix. Differentiable, so it drops into a training step unchanged.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+from tpudl import mesh as M
+from tpudl import ring_attention, shard_sequence
+
+
+def main():
+    mesh = M.build_mesh()
+    n = mesh.shape[M.DATA_AXIS]
+    B, S, H, D = 1, 1024 * n, 8, 128   # sequence scales WITH the mesh
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.normal(size=(B, S, H, D)).astype(np.float32)
+               for _ in range(3))
+    qs, ks, vs = shard_sequence((q, k, v), mesh)
+    out = ring_attention(qs, ks, vs, mesh, causal=True)
+    print("out:", out.shape, "sharded over",
+          len(out.sharding.device_set), "devices")
+
+    grads = jax.jit(jax.grad(
+        lambda a, b, c: (ring_attention(a, b, c, mesh) ** 2).sum(),
+        argnums=(0, 1, 2)))(qs, ks, vs)
+    print("grad ok:", all(np.isfinite(np.asarray(g)).all() for g in grads))
+
+
+if __name__ == "__main__":
+    main()

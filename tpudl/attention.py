@@ -27,10 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-try:  # top-level since jax 0.6
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map  # top-level since jax 0.6 (pyproject floor)
 
 from tpudl import mesh as M
 
@@ -137,10 +134,8 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
 
 def _mark_varying(t, axis):
     """Mark ``t`` device-varying over ``axis`` under shard_map's
-    varying-axis type tracking (API name moved across jax versions; a
-    jax without the tracking needs no marking at all)."""
+    varying-axis type tracking (``lax.pcast`` on current jax; ``pvary``
+    is the 0.6–0.7 spelling within the supported floor)."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(t, (axis,), to="varying")
-    if hasattr(jax.lax, "pvary"):  # pragma: no cover - older spelling
-        return jax.lax.pvary(t, (axis,))
-    return t  # pragma: no cover - pre-tracking jax
+    return jax.lax.pvary(t, (axis,))  # pragma: no cover - jax 0.6/0.7

@@ -144,6 +144,19 @@ class Frame:
         self._n = n or 0
         self.num_partitions = num_partitions
 
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_files(cls, path, num_partitions: int | None = None,
+                   host_sharded: bool = False) -> "Frame":
+        """Streaming file source: columns (filePath, fileData) where the
+        bytes column is LAZY — paths only in RAM, reads deferred to the
+        accessed batch (the ``sc.binaryFiles`` contract; delegates to
+        :func:`tpudl.image.imageIO.filesToFrame`)."""
+        from tpudl.image.imageIO import filesToFrame
+
+        return filesToFrame(path, numPartitions=num_partitions,
+                            host_sharded=host_sharded, lazy=True)
+
     # -- schema/access ----------------------------------------------------
     @property
     def columns(self) -> list[str]:
@@ -208,11 +221,7 @@ class Frame:
         names = list(subset) if subset else self.columns
         mask = np.ones(self._n, dtype=bool)
         for n in names:
-            col = self._cols[n]
-            if col.dtype == object:
-                mask &= np.array([v is not None for v in col], dtype=bool)
-            elif np.issubdtype(col.dtype, np.floating):
-                mask &= ~np.isnan(col)
+            mask &= ~null_mask(self._cols[n])
         return self.filter_rows(mask)
 
     def head(self, n: int = 5) -> "Frame":
@@ -423,6 +432,18 @@ def _drain(entry, outputs):
     for i, r in enumerate(result):
         r = np.asarray(r)  # device→host; blocks until this batch is done
         outputs[i].append(r[: r.shape[0] - n_pad] if n_pad else r)
+
+
+def null_mask(col) -> np.ndarray:
+    """Per-row null flags: object ``None`` and float ``NaN`` count as
+    null, everything else does not. The ONE definition of nullness —
+    shared by ``Frame.dropna`` and SQL ``IS NULL`` so the two can never
+    disagree. A LazyColumn streams row-by-row (O(1) held payloads)."""
+    if col.dtype == object:
+        return np.array([v is None for v in col], dtype=bool)
+    if np.issubdtype(col.dtype, np.floating):
+        return np.isnan(col)
+    return np.zeros(len(col), dtype=bool)
 
 
 def _default_pack(sl: np.ndarray) -> np.ndarray:

@@ -23,7 +23,7 @@ import re
 
 import numpy as np
 
-from tpudl.frame.frame import Frame
+from tpudl.frame.frame import Frame, null_mask
 
 __all__ = ["sql"]
 
@@ -105,11 +105,7 @@ def _where_mask(frame: Frame, where: str) -> np.ndarray:
     for pred in _AND_SPLIT_RE.split(where.strip()):
         nm = _NULL_RE.match(pred)
         if nm:
-            col = _col(frame, nm.group("col"))
-            isnull = np.array([v is None for v in col], dtype=bool) \
-                if col.dtype == object else (
-                    np.isnan(col) if np.issubdtype(col.dtype, np.floating)
-                    else np.zeros(len(frame), dtype=bool))
+            isnull = null_mask(_col(frame, nm.group("col")))
             mask &= ~isnull if nm.group("neg") else isnull
             continue
         cm = _CMP_RE.match(pred)
