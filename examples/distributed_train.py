@@ -45,6 +45,12 @@ def train_fn(ctx):
 
 
 if __name__ == "__main__":
-    # np=-1: all local devices (HorovodRunner's local-mode contract)
-    history = HorovodRunner(np=-1, checkpoint_dir="/tmp/tpudl_ckpt").run(train_fn)
+    import jax
+
+    # data-parallel over every local device (np=N mirrors the reference's
+    # HorovodRunner(np=N) rank count; negative np is the 1-device debug
+    # contract, NOT "all devices")
+    runner = HorovodRunner(np=jax.local_device_count(),
+                           checkpoint_dir="/tmp/tpudl_ckpt")
+    history = runner.run(train_fn)
     print(history[-1] if history else "no steps run")

@@ -38,6 +38,7 @@ _LAZY = {
     # long-context / sequence parallelism (TPU-native addition)
     "ring_attention": "tpudl.attention",
     "shard_sequence": "tpudl.attention",
+    "flash_attention": "tpudl.pallas_ops",
 }
 
 __all__ = ["__version__", *_LAZY]

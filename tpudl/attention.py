@@ -23,6 +23,8 @@ test mesh to a pod slice.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -58,7 +60,9 @@ def shard_sequence(tree, mesh, axis: str = M.DATA_AXIS):
 
 
 def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
-                   causal: bool = False):
+                   causal: bool = False, use_pallas: bool = False,
+                   pallas_block: int = 128,
+                   pallas_interpret: bool | None = None):
     """Sequence-parallel attention over ``mesh[axis]``.
 
     q, k, v: [batch, seq, heads, head_dim] with ``seq`` sharded over
@@ -71,12 +75,23 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
     (each hop overlaps the block's score/accumulate compute in XLA's
     schedule); memory: O(S/n) K/V per device, O((S/n)²·n → S·S/n) scores
     peak, never the full matrix.
+
+    ``use_pallas=True`` computes each ring step with the Pallas flash
+    kernel (:func:`tpudl.pallas_ops.flash_attention`) — tiled VMEM
+    score blocks, never a full (S/n)² matrix per device — and merges the
+    per-block partials exactly via their log-sum-exps (the standard
+    ring/flash-decoding merge). ``pallas_interpret`` defaults to auto
+    (interpret off TPU, compiled on TPU).
     """
     n = mesh.shape[axis]
     if q.shape[1] % n:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by ring size {n}")
     seq_spec = P(None, axis, None, None)
+    if use_pallas:
+        return _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec,
+                                      causal, pallas_block,
+                                      pallas_interpret)
 
     def local(qb, kb, vb):
         # qb/kb/vb: [B, S/n, H, D] — this device's blocks
@@ -129,6 +144,60 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
     fn = shard_map(local, mesh=mesh,
                    in_specs=(seq_spec, seq_spec, seq_spec),
                    out_specs=seq_spec)
+    return fn(q, k, v)
+
+
+def _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec, causal,
+                           block, interpret):
+    """Ring loop where each step is one Pallas flash-attention call over
+    the local Q shard and the rotating K/V block; partials merge via
+    log-sum-exp weights (exact — same math as the in-kernel online
+    softmax, applied across blocks)."""
+    from tpudl.pallas_ops import _NEG_INF, flash_attention
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local(qb, kb, vb):
+        idx = jax.lax.axis_index(axis)
+        s_loc = qb.shape[1]
+        # largest block that divides the shard (min() alone would reject
+        # shard lengths like 192 that the plain ring path accepts)
+        blk = math.gcd(s_loc, block)
+        q_off = idx * s_loc
+        o0 = jnp.zeros(qb.shape, jnp.float32)
+        lse0 = jnp.full((qb.shape[0], s_loc, qb.shape[2]), _NEG_INF,
+                        jnp.float32)
+        o0, lse0 = (_mark_varying(t, axis) for t in (o0, lse0))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(carry, s):
+            o, lse, kc, vc = carry
+            src = (idx - s) % n
+            ob, lb = flash_attention(
+                qb, kc, vc, causal=causal, q_offset=q_off,
+                k_offset=src * s_loc, block_q=blk, block_k=blk,
+                interpret=interpret, return_lse=True)
+            m = jnp.maximum(lse, lb)
+            w_prev, w_blk = jnp.exp(lse - m), jnp.exp(lb - m)
+            denom = w_prev + w_blk
+            safe = jnp.where(denom == 0.0, 1.0, denom)
+            o = (o * w_prev[..., None]
+                 + ob.astype(jnp.float32) * w_blk[..., None]) / safe[..., None]
+            lse = m + jnp.log(safe)
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return (o, lse, kc, vc), None
+
+        (o, _lse, _k, _v), _ = jax.lax.scan(
+            step, (o0, lse0, kb, vb), jnp.arange(n))
+        return o.astype(qb.dtype)
+
+    # check_vma off: pallas_call's out_shape carries no varying-axis
+    # annotation, so the tracker cannot type the kernel's outputs
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(seq_spec, seq_spec, seq_spec),
+                   out_specs=seq_spec, check_vma=False)
     return fn(q, k, v)
 
 

@@ -1,0 +1,231 @@
+"""Pallas TPU kernels for the hot attention op.
+
+Flash attention in Pallas: tiled ``softmax(QKᵀ/√d)·V`` that never
+materializes the full score matrix — Q/K/V tiles stream HBM→VMEM per
+grid step, scores hit the MXU via ``jnp.dot(..,
+preferred_element_type=f32)``, and the online-softmax state (running
+max, normalizer, weighted accumulator) lives in VMEM scratch that
+persists across the innermost (K-tile) grid dimension. Peak VMEM is
+O(block_q·block_k + block·d) instead of O(S²).
+
+The kernel also returns the per-row **log-sum-exp**, which makes it
+ring-composable: :func:`tpudl.attention.ring_attention` with
+``use_pallas=True`` runs this kernel on each rotating K/V block and
+combines the per-block (out, lse) pairs exactly — the standard
+ring/flash-decoding partial-softmax merge.
+
+``q_offset``/``k_offset`` are the blocks' global sequence positions, so
+causal masking stays correct when the caller holds only a shard of the
+sequence (the ring case).
+
+CPU/tests run the same kernel with ``interpret=True`` (pure jax
+semantics, no tiling constraints); on TPU use block sizes that are
+multiples of the (8, 128) f32 tile — the defaults are.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30  # finite -inf stand-in: exp(x - _NEG_INF) never NaNs
+
+
+def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr, *, causal: bool, scale: float,
+                  block_q: int, block_k: int, precision):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)              # [TQ, D]
+    k = k_ref[0].astype(jnp.float32)              # [TK, D]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                precision=precision) * scale
+    if causal:
+        iq = pl.program_id(1)
+        q_pos = (qoff_ref[0] + iq * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0))
+        k_pos = (koff_ref[0] + ik * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_scr[:, 0]                          # [TQ]
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])               # [TQ, TK]
+    # a row with NO visible key yet has m_new == _NEG_INF and exp(0)==1
+    # for every masked entry; zero it so l stays 0 and finalize reports
+    # the row as fully masked instead of returning mean(V)
+    p = jnp.where((m_new <= _NEG_INF * 0.5)[:, None], 0.0, p)
+    l_new = l_scr[:, 0] * corr + p.sum(axis=1)
+    acc_scr[:] = (acc_scr[:] * corr[:, None]
+                  + jnp.dot(p, v_ref[0].astype(jnp.float32),
+                            preferred_element_type=jnp.float32,
+                            precision=precision))
+    m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+        # lse = m + log(l); fully-masked rows (l==0) get -inf-equivalent.
+        # The row vector is broadcast over an 8-sublane dim purely to
+        # satisfy the TPU (8, 128) output-tile rule; callers read row 0.
+        lse = jnp.where(l == 0.0, _NEG_INF, m_scr[:, 0] + jnp.log(safe_l))
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+def _dense_bh_with_lse(qh, kh, vh, qoff, koff, causal):
+    """Head-major dense reference producing the kernel's exact (out, lse)
+    contract — the rematerialized backward for the custom VJP (flash
+    backward kernels trade FLOPs for memory the same way; here the
+    recompute is plain XLA so autodiff is free)."""
+    d = qh.shape[-1]
+    s = jnp.einsum("bqd,bkd->bqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) / (d ** 0.5)
+    if causal:
+        s_q, s_k = s.shape[-2], s.shape[-1]
+        q_pos = qoff + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        k_pos = koff + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        s = jnp.where((q_pos >= k_pos)[None], s, _NEG_INF)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where((m <= _NEG_INF * 0.5)[..., None], 0.0, p)  # no-key rows
+    l = p.sum(axis=-1)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bqk,bkd->bqd", p, vh.astype(jnp.float32)) \
+        / safe_l[..., None]
+    lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(safe_l))
+    return out.astype(qh.dtype), lse
+
+
+@functools.lru_cache(maxsize=32)
+def _flash_fn(causal: bool, block_q: int, block_k: int, interpret: bool,
+              precision):
+    """One custom-VJP'd head-major flash fn per static config: forward
+    is the Pallas kernel, backward rematerializes densely (pallas_call
+    has no generic autodiff)."""
+
+    def fwd_impl(qh, kh, vh, qoff, koff):
+        return _pallas_flash_bh(qh, kh, vh, qoff, koff, causal=causal,
+                                block_q=block_q, block_k=block_k,
+                                interpret=interpret, precision=precision)
+
+    f = jax.custom_vjp(fwd_impl)
+
+    def fwd(qh, kh, vh, qoff, koff):
+        return fwd_impl(qh, kh, vh, qoff, koff), (qh, kh, vh, qoff, koff)
+
+    def bwd(res, cots):
+        qh, kh, vh, qoff, koff = res
+        _, pullback = jax.vjp(
+            lambda a, b, c: _dense_bh_with_lse(a, b, c, qoff, koff, causal),
+            qh, kh, vh)
+        dq, dk, dv = pullback(cots)
+        return dq, dk, dv, None, None
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret",
+                              "return_lse", "precision"))
+def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
+                    k_offset=0, block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False, return_lse: bool = False,
+                    precision=None):
+    """Tiled flash attention. q: [B, Sq, H, D], k/v: [B, Sk, H, D] →
+    out [B, Sq, H, D] (and, with ``return_lse``, lse [B, Sq, H] —
+    ``logsumexp(scores)`` per query row, for ring partial merges).
+
+    ``q_offset``/``k_offset`` are the blocks' GLOBAL sequence positions
+    for causal masking; they may be traced values (each ring device
+    passes its rotating source position). ``Sq % block_q == 0`` and
+    ``Sk % block_k == 0`` are required (pad or pass smaller blocks; any
+    sizes work under ``interpret=True``)."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    if s_q % block_q or s_k % block_k:
+        raise ValueError(
+            f"seq lengths ({s_q}, {s_k}) must divide by blocks "
+            f"({block_q}, {block_k})")
+
+    # head-major [B*H, S, D]: each grid row owns one (batch, head) pair
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    out, lse = _flash_fn(causal, block_q, block_k, interpret, precision)(
+        qh, kh, vh, qoff, koff)
+    out = out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    if not return_lse:
+        return out
+    lse = lse.reshape(b, h, s_q).transpose(0, 2, 1)
+    return out, lse
+
+
+def _pallas_flash_bh(qh, kh, vh, qoff, koff, *, causal, block_q, block_k,
+                     interpret, precision=None):
+    """The raw kernel launch, head-major [BH, S, D] → (out, lse[BH, S])."""
+    bh_n, s_q, d = qh.shape
+    s_k = kh.shape[1]
+    grid = (bh_n, s_q // block_q, s_k // block_k)
+    out, lse8 = _launch(qh, kh, vh, qoff, koff, grid=grid, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret, precision=precision)
+    return out, lse8[:, 0, :]
+
+
+def _launch(qh, kh, vh, qoff, koff, *, grid, causal, block_q, block_k,
+            interpret, precision=None):
+    bh_n, s_q, d = qh.shape
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=1.0 / (d ** 0.5),
+        block_q=block_q, block_k=block_k, precision=precision)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # q global offset
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # k global offset
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh_n, s_q, d), qh.dtype),
+            # lse rides an 8-sublane broadcast dim for TPU output tiling
+            jax.ShapeDtypeStruct((bh_n, 8, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running norm l
+            pltpu.VMEM((block_q, d), jnp.float32),    # weighted acc
+        ],
+        interpret=interpret,
+    )(qoff, koff, qh, kh, vh)
