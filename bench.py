@@ -375,8 +375,11 @@ def measure_decode():
 
 def measure_flash_attention():
     """Pallas flash-attention kernel vs dense XLA attention on the live
-    backend (causal, S=2048, H=8, D=128). Honest barrier: per-call scalar
-    fetch chained across reps. The kernel's main win is O(S·block)
+    backend (causal, S=2048, H=8, D=128). Honest barrier: the reps'
+    scalar outputs chain into ONE data-dependent value fetched at the
+    end, so the queue fully drains (per-call dispatch latency is
+    amortized across reps — this measures sustained throughput, not
+    round-trip latency). The kernel's main win is O(S·block) forward
     memory (no S² score materialization), with speed at parity or
     better."""
     import jax

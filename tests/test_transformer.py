@@ -1,0 +1,93 @@
+"""Long-context causal LM tests: dense == ring == ring+pallas forward
+parity, and TRAINING through the standard Trainer over the mesh — the
+sequence axis re-shards inside attention (DP batch outside, SP ring
+inside: the all-to-all transition XLA inserts from the shard_map specs).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpudl import mesh as M
+from tpudl.zoo.transformer import TinyCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TinyCausalLM(vocab=32, dim=32, heads=2, layers=2)
+
+
+@pytest.fixture(scope="module")
+def tokens(rng):
+    return rng.integers(0, 32, size=(2, 64), dtype=np.int32)
+
+
+class TestForwardParity:
+    def test_ring_matches_dense(self, model, tokens):
+        mesh = M.build_mesh()
+        params = model.init(0)
+        dense = np.asarray(model.apply(params, jnp.asarray(tokens)))
+        ring = np.asarray(model.apply(params, jnp.asarray(tokens),
+                                      mesh=mesh))
+        np.testing.assert_allclose(ring, dense, rtol=2e-4, atol=2e-4)
+
+    def test_ring_pallas_matches_dense(self, model, tokens):
+        mesh = M.build_mesh()
+        params = model.init(0)
+        dense = np.asarray(model.apply(params, jnp.asarray(tokens)))
+        ringp = np.asarray(model.apply(params, jnp.asarray(tokens),
+                                       mesh=mesh, use_pallas=True))
+        np.testing.assert_allclose(ringp, dense, rtol=2e-4, atol=2e-4)
+
+    def test_logits_shape_and_finiteness(self, model, tokens):
+        out = model.apply(model.init(0), jnp.asarray(tokens))
+        assert out.shape == (2, 64, 32)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestLongContextTraining:
+    def _data(self, batch, seqlen, vocab=32):
+        """Deterministic periodic sequences — learnable in a few steps."""
+        rng = np.random.default_rng(7)
+        base = rng.integers(0, vocab, size=(batch, 8), dtype=np.int32)
+        reps = -(-seqlen // 8)
+        return np.tile(base, (1, reps))[:, :seqlen]
+
+    def test_trainer_over_mesh_learns(self, model, mesh8):
+        """Full integration: Trainer + make_train_step + ring attention.
+        Loss must drop and the mesh run must match single-device."""
+        from tpudl.train.runner import Trainer
+
+        toks = self._data(batch=8, seqlen=65)  # 64 after shift; 64 % 8 == 0
+        params = model.init(0)
+
+        # single-device reference (dense attention)
+        tr_ref = Trainer(model.loss_fn(), optax.adam(1e-2))
+        p_ref, _, _ = tr_ref.fit(params, lambda s: (toks,), steps=5)
+
+        # mesh run: batch sharded on data, ring attention inside
+        tr = Trainer(model.loss_fn(mesh=mesh8), optax.adam(1e-2),
+                     mesh=mesh8)
+        p_mesh, _, hist = tr.fit(params, lambda s: (toks,), steps=5)
+
+        l0 = float(model.loss_fn()(params, jnp.asarray(toks)))
+        l_ref = float(model.loss_fn()(
+            jax.tree.map(np.asarray, p_ref), jnp.asarray(toks)))
+        l_mesh = float(model.loss_fn()(
+            jax.tree.map(np.asarray, p_mesh), jnp.asarray(toks)))
+        assert l_ref < l0, f"reference did not learn: {l0} -> {l_ref}"
+        assert l_mesh < l0, f"mesh run did not learn: {l0} -> {l_mesh}"
+        np.testing.assert_allclose(l_mesh, l_ref, rtol=1e-2, atol=1e-2)
+
+    def test_sequence_longer_than_single_shard(self, model, mesh8):
+        """Sequence 8x a shard: exactly the shape ring attention exists
+        for; forward must equal dense at full length."""
+        toks = self._data(batch=1, seqlen=128)
+        params = model.init(1)
+        dense = np.asarray(model.apply(params, jnp.asarray(toks)))
+        ring = np.asarray(model.apply(params, jnp.asarray(toks),
+                                      mesh=mesh8))
+        np.testing.assert_allclose(ring, dense, rtol=3e-4, atol=3e-4)

@@ -39,6 +39,7 @@ _LAZY = {
     "ring_attention": "tpudl.attention",
     "shard_sequence": "tpudl.attention",
     "flash_attention": "tpudl.pallas_ops",
+    "TinyCausalLM": "tpudl.zoo.transformer",
 }
 
 __all__ = ["__version__", *_LAZY]

@@ -77,11 +77,15 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
     peak, never the full matrix.
 
     ``use_pallas=True`` computes each ring step with the Pallas flash
-    kernel (:func:`tpudl.pallas_ops.flash_attention`) — tiled VMEM
-    score blocks, never a full (S/n)² matrix per device — and merges the
-    per-block partials exactly via their log-sum-exps (the standard
-    ring/flash-decoding merge). ``pallas_interpret`` defaults to auto
-    (interpret off TPU, compiled on TPU).
+    kernel (:func:`tpudl.pallas_ops.flash_attention`) — the FORWARD pass
+    streams tiled VMEM score blocks and never materializes an (S/n)²
+    matrix per device, and strictly-future hops/tiles are skipped under
+    causal masking. The BACKWARD pass currently rematerializes each ring
+    block densely (the kernel's custom VJP), so training peak memory
+    matches the plain ring path; the pallas win under ``jax.grad`` is
+    compute, not memory. Partials merge exactly via their log-sum-exps
+    (the standard ring/flash-decoding merge). ``pallas_interpret``
+    defaults to auto (interpret off TPU, compiled on TPU).
     """
     n = mesh.shape[axis]
     if q.shape[1] % n:
@@ -174,10 +178,24 @@ def _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec, causal,
         def step(carry, s):
             o, lse, kc, vc = carry
             src = (idx - s) % n
-            ob, lb = flash_attention(
-                qb, kc, vc, causal=causal, q_offset=q_off,
-                k_offset=src * s_loc, block_q=blk, block_k=blk,
-                interpret=interpret, return_lse=True)
+
+            def live(args):
+                kc, vc = args
+                return flash_attention(
+                    qb, kc, vc, causal=causal, q_offset=q_off,
+                    k_offset=src * s_loc, block_q=blk, block_k=blk,
+                    interpret=interpret, return_lse=True)
+
+            def future(args):
+                return (jnp.zeros(qb.shape, qb.dtype),
+                        jnp.full(lse0.shape, _NEG_INF, jnp.float32))
+
+            if causal:
+                # a hop whose K block is strictly in this shard's future
+                # contributes weight exp(-inf); skip the whole launch
+                ob, lb = jax.lax.cond(src <= idx, live, future, (kc, vc))
+            else:
+                ob, lb = live((kc, vc))
             m = jnp.maximum(lse, lb)
             w_prev, w_blk = jnp.exp(lse - m), jnp.exp(lb - m)
             denom = w_prev + w_blk

@@ -49,35 +49,45 @@ def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)              # [TQ, D]
-    k = k_ref[0].astype(jnp.float32)              # [TK, D]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-                precision=precision) * scale
+    iq = pl.program_id(1)
     if causal:
-        iq = pl.program_id(1)
-        q_pos = (qoff_ref[0] + iq * block_q
-                 + jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 0))
-        k_pos = (koff_ref[0] + ik * block_k
-                 + jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 1))
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        # a K tile strictly in the future of every row of this Q tile
+        # contributes nothing; skip BOTH MXU passes (≈2x for long causal)
+        tile_live = (koff_ref[0] + ik * block_k
+                     <= qoff_ref[0] + (iq + 1) * block_q - 1)
+    else:
+        tile_live = jnp.bool_(True)
 
-    m_prev = m_scr[:, 0]                          # [TQ]
-    m_new = jnp.maximum(m_prev, s.max(axis=1))
-    corr = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, None])               # [TQ, TK]
-    # a row with NO visible key yet has m_new == _NEG_INF and exp(0)==1
-    # for every masked entry; zero it so l stays 0 and finalize reports
-    # the row as fully masked instead of returning mean(V)
-    p = jnp.where((m_new <= _NEG_INF * 0.5)[:, None], 0.0, p)
-    l_new = l_scr[:, 0] * corr + p.sum(axis=1)
-    acc_scr[:] = (acc_scr[:] * corr[:, None]
-                  + jnp.dot(p, v_ref[0].astype(jnp.float32),
-                            preferred_element_type=jnp.float32,
-                            precision=precision))
-    m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+    @pl.when(tile_live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)              # [TQ, D]
+        k = k_ref[0].astype(jnp.float32)              # [TK, D]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=precision) * scale
+        if causal:
+            q_pos = (qoff_ref[0] + iq * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0))
+            k_pos = (koff_ref[0] + ik * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]                          # [TQ]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])               # [TQ, TK]
+        # a row with NO visible key yet has m_new == _NEG_INF and
+        # exp(0)==1 for every masked entry; zero it so l stays 0 and
+        # finalize reports the row as fully masked, not mean(V)
+        p = jnp.where((m_new <= _NEG_INF * 0.5)[:, None], 0.0, p)
+        l_new = l_scr[:, 0] * corr + p.sum(axis=1)
+        acc_scr[:] = (acc_scr[:] * corr[:, None]
+                      + jnp.dot(p, v_ref[0].astype(jnp.float32),
+                                preferred_element_type=jnp.float32,
+                                precision=precision))
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
     @pl.when(ik == nk - 1)
     def _finalize():
@@ -168,6 +178,15 @@ def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
         raise ValueError(
             f"seq lengths ({s_q}, {s_k}) must divide by blocks "
             f"({block_q}, {block_k})")
+    if not interpret:
+        # Mosaic tiling: a block's trailing dims must be (8, 128)-aligned
+        # OR equal the full array dim. block_q is the lse lane dim and the
+        # q sublane dim; block_k is the k sublane dim. An unaligned
+        # request falls back to the always-legal full-dim block.
+        if block_q % 128 and block_q != s_q:
+            block_q = s_q
+        if block_k % 8 and block_k != s_k:
+            block_k = s_k
 
     # head-major [B*H, S, D]: each grid row owns one (batch, head) pair
     def to_bh(x):

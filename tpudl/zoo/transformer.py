@@ -1,0 +1,137 @@
+"""Long-context causal transformer — the model family that exercises
+sequence parallelism end-to-end.
+
+The reference has no sequence model (its zoo is image CNNs, SURVEY.md
+§2.1); tpudl's charter makes long context first-class, so this is the
+TPU-native addition that turns :func:`tpudl.attention.ring_attention`
+from an op into a trainable model: a pre-norm causal decoder whose
+attention runs as a mesh ring when given a mesh (K/V rotating on ICI,
+O(S/n) per device), and as :func:`tpudl.pallas_ops.flash_attention`
+tiles when ``use_pallas``. Pure functions over a param pytree, same
+style as the CNN zoo — drops straight into
+``tpudl.train.Trainer``/``make_train_step`` (the batch stays sharded on
+the data axis for the loss; the sequence axis shards inside attention).
+
+Parameters follow the zoo convention: a flat dict of layer-name →
+{param-name: array}, seedable via ``init``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TinyCausalLM"]
+
+
+def _layer_norm(x, p, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["gamma"] + p["beta"]
+
+
+class TinyCausalLM:
+    """A small pre-norm decoder LM: embed → [attn + mlp]×L → logits.
+
+    ``apply(params, tokens, mesh=None, use_pallas=False)`` returns
+    next-token logits. With ``mesh``, attention is
+    :func:`ring_attention` over the mesh's data axis (the sequence must
+    divide by the axis size); without, it is dense causal attention —
+    identical math, proven in tests.
+    """
+
+    def __init__(self, vocab: int = 256, dim: int = 64, heads: int = 4,
+                 layers: int = 2, max_len: int = 4096):
+        if dim % heads:
+            raise ValueError(f"dim {dim} not divisible by heads {heads}")
+        self.vocab = vocab
+        self.dim = dim
+        self.heads = heads
+        self.layers = layers
+        self.max_len = max_len
+
+    # -- params -----------------------------------------------------------
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        d, v = self.dim, self.vocab
+
+        def w(*shape, scale=None):
+            scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
+            return (rng.normal(size=shape) * scale).astype(np.float32)
+
+        params: dict = {
+            "embed": {"table": w(v, d, scale=0.02)},
+            "final_norm": {"gamma": np.ones(d, np.float32),
+                           "beta": np.zeros(d, np.float32)},
+        }
+        for i in range(self.layers):
+            params[f"block_{i}"] = {
+                "norm1_gamma": np.ones(d, np.float32),
+                "norm1_beta": np.zeros(d, np.float32),
+                "wq": w(d, d), "wk": w(d, d), "wv": w(d, d), "wo": w(d, d),
+                "norm2_gamma": np.ones(d, np.float32),
+                "norm2_beta": np.zeros(d, np.float32),
+                "w_up": w(d, 4 * d), "b_up": np.zeros(4 * d, np.float32),
+                "w_down": w(4 * d, d), "b_down": np.zeros(d, np.float32),
+            }
+        return params
+
+    # -- forward ----------------------------------------------------------
+    def apply(self, params, tokens, *, mesh=None, use_pallas: bool = False):
+        """tokens [B, S] int32 → logits [B, S, vocab]."""
+        from tpudl.attention import attention_reference, ring_attention
+
+        b, s = tokens.shape
+        if s > self.max_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_len {self.max_len}")
+        x = params["embed"]["table"][tokens]              # [B, S, D]
+        # rotary-free: learned-position-less (relative order comes from
+        # the causal mask; adequate for the convergence tests this
+        # model exists for, and keeps the ring path position-agnostic)
+        for i in range(self.layers):
+            p = params[f"block_{i}"]
+            h = _layer_norm(x, {"gamma": p["norm1_gamma"],
+                                "beta": p["norm1_beta"]})
+            q, k, v = (h @ p[w] for w in ("wq", "wk", "wv"))
+            def split(t):
+                return t.reshape(b, s, self.heads, self.dim // self.heads)
+            q, k, v = split(q), split(k), split(v)
+            if mesh is not None:
+                att = ring_attention(q, k, v, mesh, causal=True,
+                                     use_pallas=use_pallas)
+            elif use_pallas:
+                from tpudl.pallas_ops import flash_attention
+
+                att = flash_attention(
+                    q, k, v, causal=True,
+                    interpret=jax.default_backend() != "tpu")
+            else:
+                att = attention_reference(q, k, v, causal=True)
+            x = x + att.reshape(b, s, self.dim) @ p["wo"]
+            h = _layer_norm(x, {"gamma": p["norm2_gamma"],
+                                "beta": p["norm2_beta"]})
+            h = jax.nn.gelu(h @ p["w_up"] + p["b_up"])
+            x = x + h @ p["w_down"] + p["b_down"]
+        x = _layer_norm(x, params["final_norm"])
+        return x @ params["embed"]["table"].T              # tied head
+
+    # -- training loss -----------------------------------------------------
+    def loss_fn(self, *, mesh=None, use_pallas: bool = False):
+        """``loss(params, tokens)``: next-token cross-entropy, mean over
+        the global batch (the allreduce contraction —
+        tpudl.train.make_train_step turns it into the ICI psum)."""
+
+        def loss(params, tokens):
+            logits = self.apply(params, tokens[:, :-1], mesh=mesh,
+                                use_pallas=use_pallas)
+            targets = tokens[:, 1:]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            picked = jnp.take_along_axis(
+                logp, targets[..., None].astype(jnp.int32), axis=-1)
+            return -jnp.mean(picked)
+
+        return loss
