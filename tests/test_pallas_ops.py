@@ -1,8 +1,9 @@
 """Pallas flash-attention kernel tests (interpret mode on the CPU mesh —
 identical kernel semantics; the TPU path compiles the same pallas_call).
 The kernel must match the dense oracle exactly, compose across blocks
-via its log-sum-exp output, and back-propagate (custom VJP with dense
-rematerialization) to the oracle's gradients."""
+via its log-sum-exp output, and back-propagate to the oracle's gradients
+through the tiled Pallas dq/dk/dv backward kernels (custom VJP from the
+saved log-sum-exp — no S^2 tensor in either direction)."""
 
 import numpy as np
 import pytest

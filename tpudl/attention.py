@@ -77,15 +77,14 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
     peak, never the full matrix.
 
     ``use_pallas=True`` computes each ring step with the Pallas flash
-    kernel (:func:`tpudl.pallas_ops.flash_attention`) — the FORWARD pass
-    streams tiled VMEM score blocks and never materializes an (S/n)²
-    matrix per device, and strictly-future hops/tiles are skipped under
-    causal masking. The BACKWARD pass currently rematerializes each ring
-    block densely (the kernel's custom VJP), so training peak memory
-    matches the plain ring path; the pallas win under ``jax.grad`` is
-    compute, not memory. Partials merge exactly via their log-sum-exps
-    (the standard ring/flash-decoding merge). ``pallas_interpret``
-    defaults to auto (interpret off TPU, compiled on TPU).
+    kernel (:func:`tpudl.pallas_ops.flash_attention`): forward AND
+    backward are tiled kernels (the custom VJP launches flash dq/dk/dv
+    kernels from the saved log-sum-exp), so neither direction
+    materializes an (S/n)² matrix per device, and strictly-future
+    hops/tiles are skipped under causal masking. Partials merge exactly
+    via their log-sum-exps (the standard ring/flash-decoding merge).
+    ``pallas_interpret`` defaults to auto (interpret off TPU, compiled
+    on TPU).
     """
     n = mesh.shape[axis]
     if q.shape[1] % n:

@@ -37,6 +37,42 @@ __all__ = ["flash_attention"]
 _NEG_INF = -1e30  # finite -inf stand-in: exp(x - _NEG_INF) never NaNs
 
 
+def _tile_live(causal, qoff_ref, koff_ref, iq, ik, block_q, block_k):
+    """Whether this (Q, K) tile has ANY visible pair under causal
+    masking — the shared tile-skip predicate for all three kernels."""
+    if not causal:
+        return jnp.bool_(True)
+    return (koff_ref[0] + ik * block_k
+            <= qoff_ref[0] + (iq + 1) * block_q - 1)
+
+
+def _masked_scores(q_ref, k_ref, qoff_ref, koff_ref, iq, ik, *, causal,
+                   scale, block_q, block_k, precision):
+    """QKᵀ·scale with the global-position causal mask applied — the ONE
+    definition of the score tile shared by forward, dq and dkv kernels."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                precision=precision) * scale
+    if causal:
+        q_pos = (qoff_ref[0] + iq * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0))
+        k_pos = (koff_ref[0] + ik * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return q, k, s
+
+
+def _bwd_p(s, lse):
+    """Reconstruct softmax weights from the saved log-sum-exp, zeroing
+    rows that saw no key (f32 multiplicand: a bool minor-dim insertion
+    is unsupported in Mosaic for non-32-bit types)."""
+    alive = (lse > _NEG_INF * 0.5).astype(jnp.float32)[:, None]
+    return jnp.exp(s - lse[:, None]) * alive
+
+
 def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr, *, causal: bool, scale: float,
                   block_q: int, block_k: int, precision):
@@ -50,28 +86,16 @@ def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     iq = pl.program_id(1)
-    if causal:
-        # a K tile strictly in the future of every row of this Q tile
-        # contributes nothing; skip BOTH MXU passes (≈2x for long causal)
-        tile_live = (koff_ref[0] + ik * block_k
-                     <= qoff_ref[0] + (iq + 1) * block_q - 1)
-    else:
-        tile_live = jnp.bool_(True)
+    # a K tile strictly in the future of every row of this Q tile
+    # contributes nothing; skip BOTH MXU passes (≈2x for long causal)
+    live = _tile_live(causal, qoff_ref, koff_ref, iq, ik, block_q, block_k)
 
-    @pl.when(tile_live)
+    @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)              # [TQ, D]
-        k = k_ref[0].astype(jnp.float32)              # [TK, D]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-                    precision=precision) * scale
-        if causal:
-            q_pos = (qoff_ref[0] + iq * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32,
-                                                (block_q, block_k), 0))
-            k_pos = (koff_ref[0] + ik * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32,
-                                                (block_q, block_k), 1))
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        _q, _k, s = _masked_scores(
+            q_ref, k_ref, qoff_ref, koff_ref, iq, ik, causal=causal,
+            scale=scale, block_q=block_q, block_k=block_k,
+            precision=precision)
 
         m_prev = m_scr[:, 0]                          # [TQ]
         m_new = jnp.maximum(m_prev, s.max(axis=1))
@@ -101,36 +125,169 @@ def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
-def _dense_bh_with_lse(qh, kh, vh, qoff, koff, causal):
-    """Head-major dense reference producing the kernel's exact (out, lse)
-    contract — the rematerialized backward for the custom VJP (flash
-    backward kernels trade FLOPs for memory the same way; here the
-    recompute is plain XLA so autodiff is free)."""
-    d = qh.shape[-1]
-    s = jnp.einsum("bqd,bkd->bqk", qh.astype(jnp.float32),
-                   kh.astype(jnp.float32)) / (d ** 0.5)
-    if causal:
-        s_q, s_k = s.shape[-2], s.shape[-1]
-        q_pos = qoff + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
-        k_pos = koff + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
-        s = jnp.where((q_pos >= k_pos)[None], s, _NEG_INF)
-    m = s.max(axis=-1)
-    p = jnp.exp(s - m[..., None])
-    p = jnp.where((m <= _NEG_INF * 0.5)[..., None], 0.0, p)  # no-key rows
-    l = p.sum(axis=-1)
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = jnp.einsum("bqk,bkd->bqd", p, vh.astype(jnp.float32)) \
-        / safe_l[..., None]
-    lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(safe_l))
-    return out.astype(qh.dtype), lse
+def _bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, dlt_ref, dq_ref, dq_scr, *, causal: bool,
+                   scale: float, block_q: int, block_k: int, precision):
+    """dq = Σ_k  p ⊙ (dOVᵀ − δ + dlse) · scale @ K, accumulated over the
+    innermost K-tile grid dim — same tiling discipline as the forward,
+    no S² materialization. δ = rowsum(dO ⊙ O), and ``p = exp(s − lse)``
+    reconstructs the softmax weights from the saved log-sum-exp."""
+    iq, ik, nk = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = _tile_live(causal, qoff_ref, koff_ref, iq, ik, block_q, block_k)
+
+    @pl.when(live)
+    def _compute():
+        q, k, s = _masked_scores(
+            q_ref, k_ref, qoff_ref, koff_ref, iq, ik, causal=causal,
+            scale=scale, block_q=block_q, block_k=block_k,
+            precision=precision)
+        p = _bwd_p(s, lse_ref[0, 0])
+        do = do_ref[0].astype(jnp.float32)
+        dp = jnp.dot(do, v_ref[0].astype(jnp.float32).T,
+                     preferred_element_type=jnp.float32,
+                     precision=precision)
+        ds = p * (dp - dlt_ref[0, 0][:, None]) * scale
+        dq_scr[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32,
+                             precision=precision)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, dlt_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    causal: bool, scale: float, block_q: int,
+                    block_k: int, precision):
+    """dk = Σ_q (p ⊙ (dOVᵀ − δ + dlse) · scale)ᵀ @ Q ; dv = Σ_q pᵀ @ dO —
+    grid over K tiles with the Q-tile dim innermost."""
+    ik, iq, nq = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = _tile_live(causal, qoff_ref, koff_ref, iq, ik, block_q, block_k)
+
+    @pl.when(live)
+    def _compute():
+        q, k, s = _masked_scores(
+            q_ref, k_ref, qoff_ref, koff_ref, iq, ik, causal=causal,
+            scale=scale, block_q=block_q, block_k=block_k,
+            precision=precision)
+        p = _bwd_p(s, lse_ref[0, 0])
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32,
+                             precision=precision)
+        dp = jnp.dot(do, v_ref[0].astype(jnp.float32).T,
+                     preferred_element_type=jnp.float32,
+                     precision=precision)
+        ds = p * (dp - dlt_ref[0, 0][:, None]) * scale
+        dk_scr[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32,
+                             precision=precision)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_flash_bwd(qh, kh, vh, out, lse, qoff, koff, do, dlse, *,
+                      causal, block_q, block_k, interpret, precision):
+    """Tiled flash backward: (dq, dk, dv) without any S² tensor.
+
+    The lse cotangent folds in analytically: ∂lse_i/∂s_ij = p_ij, so the
+    shared score gradient is ds = p ⊙ (dOVᵀ − δ + dlse) with
+    δ = rowsum(dO ⊙ O) − the δ and dlse terms combine into one per-row
+    constant fed to both kernels."""
+    bh_n, s_q, d = qh.shape
+    s_k = kh.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    do32 = do.astype(jnp.float32)
+    # per-row constant: −δ + dlse, folded so the kernels need ONE vector
+    dlt = (jnp.sum(do32 * out.astype(jnp.float32), axis=-1)
+           - dlse.astype(jnp.float32))
+    # broadcast row vectors over an 8-sublane dim (TPU input tiling)
+    lse8 = jnp.broadcast_to(lse[:, None, :], (bh_n, 8, s_q))
+    dlt8 = jnp.broadcast_to(dlt[:, None, :], (bh_n, 8, s_q))
+    kernel_kw = dict(causal=causal, scale=scale, block_q=block_q,
+                     block_k=block_k, precision=precision)
+
+    # dq: grid (BH, Sq/TQ, Sk/TK) — q tile fixed per row, K innermost
+    def qi_q(bh, iq, ik):
+        return (bh, iq, 0)
+
+    def qi_k(bh, iq, ik):
+        return (bh, ik, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kernel_kw),
+        grid=(bh_n, s_q // block_q, s_k // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), qi_q),
+            pl.BlockSpec((1, block_k, d), qi_k),
+            pl.BlockSpec((1, block_k, d), qi_k),
+            pl.BlockSpec((1, block_q, d), qi_q),
+            pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), qi_q),
+        out_shape=jax.ShapeDtypeStruct((bh_n, s_q, d), qh.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qoff, koff, qh, kh, vh, do, lse8, dlt8)
+
+    # dk/dv: grid (BH, Sk/TK, Sq/TQ) — k tile fixed per row, Q innermost
+    def ki_k(bh, ik, iq):
+        return (bh, ik, 0)
+
+    def ki_q(bh, ik, iq):
+        return (bh, iq, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kernel_kw),
+        grid=(bh_n, s_k // block_k, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), ki_q),
+            pl.BlockSpec((1, block_k, d), ki_k),
+            pl.BlockSpec((1, block_k, d), ki_k),
+            pl.BlockSpec((1, block_q, d), ki_q),
+            pl.BlockSpec((1, 8, block_q), lambda bh, ik, iq: (bh, 0, iq)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, ik, iq: (bh, 0, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), ki_k),
+            pl.BlockSpec((1, block_k, d), ki_k),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh_n, s_k, d), kh.dtype),
+            jax.ShapeDtypeStruct((bh_n, s_k, d), vh.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, koff, qh, kh, vh, do, lse8, dlt8)
+    return dq, dk, dv
 
 
 @functools.lru_cache(maxsize=32)
 def _flash_fn(causal: bool, block_q: int, block_k: int, interpret: bool,
               precision):
     """One custom-VJP'd head-major flash fn per static config: forward
-    is the Pallas kernel, backward rematerializes densely (pallas_call
-    has no generic autodiff)."""
+    AND backward are Pallas kernels (pallas_call has no generic
+    autodiff), so neither direction materializes an S² tensor."""
 
     def fwd_impl(qh, kh, vh, qoff, koff):
         return _pallas_flash_bh(qh, kh, vh, qoff, koff, causal=causal,
@@ -140,14 +297,16 @@ def _flash_fn(causal: bool, block_q: int, block_k: int, interpret: bool,
     f = jax.custom_vjp(fwd_impl)
 
     def fwd(qh, kh, vh, qoff, koff):
-        return fwd_impl(qh, kh, vh, qoff, koff), (qh, kh, vh, qoff, koff)
+        out, lse = fwd_impl(qh, kh, vh, qoff, koff)
+        return (out, lse), (qh, kh, vh, out, lse, qoff, koff)
 
     def bwd(res, cots):
-        qh, kh, vh, qoff, koff = res
-        _, pullback = jax.vjp(
-            lambda a, b, c: _dense_bh_with_lse(a, b, c, qoff, koff, causal),
-            qh, kh, vh)
-        dq, dk, dv = pullback(cots)
+        qh, kh, vh, out, lse, qoff, koff = res
+        do, dlse = cots
+        dq, dk, dv = _pallas_flash_bwd(
+            qh, kh, vh, out, lse, qoff, koff, do, dlse, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            precision=precision)
         return dq, dk, dv, None, None
 
     f.defvjp(fwd, bwd)
