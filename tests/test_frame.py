@@ -276,3 +276,19 @@ class TestSqlWhere:
         sql, tables = self._t()
         out = sql("SELECT x FROM t WHERE x != 2", tables)
         np.testing.assert_array_equal(out["x"], [1.0, 3.0])  # no NaN row
+
+    def test_object_column_vs_number_fails_rows_not_query(self):
+        """round-3 ADVICE: 'a' < 5 is a per-row type mismatch — the row
+        fails the predicate (like NULL), the query doesn't crash."""
+        sql, _ = self._t()
+        t = Frame({"v": np.array(["a", 7, None, 3], dtype=object)})
+        out = sql("SELECT v FROM t WHERE v < 5", {"t": t})
+        assert list(out["v"]) == [3]
+
+    def test_numeric_column_vs_string_literal_raises(self):
+        """round-3 ADVICE: numeric col vs string literal would silently
+        broadcast False (selecting nothing); must raise naming the
+        predicate instead."""
+        sql, tables = self._t()
+        with pytest.raises(ValueError, match="string literal"):
+            sql("SELECT x FROM t WHERE x = 'two'", tables)

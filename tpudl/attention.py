@@ -135,8 +135,7 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
             l = l * corr + p.sum(axis=-1)
             acc = acc * corr[..., None] + jnp.einsum(
                 "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
-            kc = jax.lax.ppermute(kc, axis, perm)
-            vc = jax.lax.ppermute(vc, axis, perm)
+            kc, vc = _rotate_unless_last(kc, vc, s, n, axis, perm)
             return (m_new, l, acc, kc, vc), None
 
         (m, l, acc, _k, _v), _ = jax.lax.scan(
@@ -202,8 +201,7 @@ def _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec, causal,
             o = (o * w_prev[..., None]
                  + ob.astype(jnp.float32) * w_blk[..., None]) / safe[..., None]
             lse = m + jnp.log(safe)
-            kc = jax.lax.ppermute(kc, axis, perm)
-            vc = jax.lax.ppermute(vc, axis, perm)
+            kc, vc = _rotate_unless_last(kc, vc, s, n, axis, perm)
             return (o, lse, kc, vc), None
 
         (o, _lse, _k, _v), _ = jax.lax.scan(
@@ -216,6 +214,21 @@ def _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec, causal,
                    in_specs=(seq_spec, seq_spec, seq_spec),
                    out_specs=seq_spec, check_vma=False)
     return fn(q, k, v)
+
+
+def _rotate_unless_last(kc, vc, s, n, axis, perm):
+    """Rotate the K/V blocks one ring hop — except on the final scan step,
+    whose rotated blocks would be discarded (n-1 hops suffice for n
+    blocks; the predicate is the uniform scan counter, so every device
+    takes the same branch and the collective stays matched)."""
+    if n == 1:
+        return kc, vc
+    return jax.lax.cond(
+        s < n - 1,
+        lambda kv: (jax.lax.ppermute(kv[0], axis, perm),
+                    jax.lax.ppermute(kv[1], axis, perm)),
+        lambda kv: kv,
+        (kc, vc))
 
 
 def _mark_varying(t, axis):

@@ -118,10 +118,16 @@ def _where_mask(frame: Frame, where: str) -> np.ndarray:
         lit = lit_raw[1:-1] if lit_raw.startswith("'") else float(lit_raw)
         op = cm.group("op")
         if col.dtype == object:
-            mask &= np.array(
-                [False if v is None else bool(_cmp(v, op, lit))
-                 for v in col], dtype=bool)
+            # per-row compare: None and type-mismatched values (e.g.
+            # 'text' < 5) both fail the predicate, like SQL NULL
+            mask &= np.array([_row_cmp(v, op, lit) for v in col], dtype=bool)
         else:
+            if isinstance(lit, str):
+                # numpy would broadcast a scalar False here, silently
+                # selecting nothing; name the predicate instead
+                raise ValueError(
+                    f"WHERE predicate {pred!r} compares numeric column "
+                    f"{cm.group('col')!r} against string literal {lit_raw}")
             res = np.asarray(_cmp(col, op, lit), dtype=bool)
             if np.issubdtype(col.dtype, np.floating):
                 res &= ~np.isnan(col)  # NaN fails != too, not just ==/<
@@ -133,6 +139,15 @@ def _col(frame: Frame, name: str) -> np.ndarray:
     if name not in frame:
         raise KeyError(f"unknown column {name!r}; have {frame.columns}")
     return frame[name]
+
+
+def _row_cmp(v, op: str, lit) -> bool:
+    if v is None:
+        return False
+    try:
+        return bool(_cmp(v, op, lit))
+    except TypeError:
+        return False  # 'text' < 5 etc: fails the predicate, not the query
 
 
 def _cmp(a, op: str, b):

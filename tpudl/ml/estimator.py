@@ -206,6 +206,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         losses = []
         for _epoch in range(epochs):
             order = rng.permutation(n) if shuffle else np.arange(n)
+            batch_losses = []  # device-resident; ONE fetch per epoch
             for start in range(0, n, target):
                 idx = order[start:start + target]
                 if len(idx) < target:
@@ -219,7 +220,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     xb, yb = jax.device_put((xb, yb), devs[0])
                 params, opt_state, loss = entry.step(
                     params, opt_state, xb, yb)
-            losses.append(float(loss))
+                batch_losses.append(loss)
+            # the epoch's loss is the MEAN over its batches (one batch's
+            # noise is a misleading trial score for CrossValidator)
+            losses.append(float(jnp.mean(jnp.stack(batch_losses))))
         return params, losses
 
     # -- model materialization --------------------------------------------

@@ -26,6 +26,7 @@ multiples of the (8, 128) f32 tile — the defaults are.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -326,26 +327,26 @@ def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
 
     ``q_offset``/``k_offset`` are the blocks' GLOBAL sequence positions
     for causal masking; they may be traced values (each ring device
-    passes its rotating source position). ``Sq % block_q == 0`` and
-    ``Sk % block_k == 0`` are required (pad or pass smaller blocks; any
-    sizes work under ``interpret=True``)."""
+    passes its rotating source position). Block sizes are advisory:
+    non-dividing or Mosaic-unaligned requests shrink to the largest
+    legal divisor (full-dim at worst), so any sequence length works."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
-    if s_q % block_q or s_k % block_k:
-        raise ValueError(
-            f"seq lengths ({s_q}, {s_k}) must divide by blocks "
-            f"({block_q}, {block_k})")
+    # non-dividing block requests shrink to the largest divisor (e.g.
+    # S=192, block=128 → 64) instead of erroring — same gcd discipline
+    # as the ring path, so standalone callers get it too
+    block_q = math.gcd(min(block_q, s_q), s_q)
+    block_k = math.gcd(min(block_k, s_k), s_k)
     if not interpret:
         # Mosaic tiling: a block's trailing dims must be (8, 128)-aligned
         # OR equal the full array dim. block_q is the lse lane dim and the
         # q sublane dim; block_k is the k sublane dim. An unaligned
-        # request falls back to the always-legal full-dim block.
+        # result falls back to the always-legal full-dim block.
         if block_q % 128 and block_q != s_q:
             block_q = s_q
         if block_k % 8 and block_k != s_k:
             block_k = s_k
+    assert s_q % block_q == 0 and s_k % block_k == 0
 
     # head-major [B*H, S, D]: each grid row owns one (batch, head) pair
     def to_bh(x):
