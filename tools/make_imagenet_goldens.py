@@ -48,7 +48,7 @@ def main():
     os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
     import keras  # noqa: E402
 
-    from tpudl.zoo.convert import save_named_params
+    from tpudl.zoo.convert import params_from_keras, save_params_npz
     from tpudl.zoo.registry import SUPPORTED_MODELS, getKerasApplicationModel
 
     os.makedirs(args.weights_dir, exist_ok=True)
@@ -58,19 +58,28 @@ def main():
         model = getKerasApplicationModel(name)
         h, w = model.input_size
         print(f"{name}: converting imagenet weights ...", flush=True)
+        # ONE full-weights build serves both the artifact conversion and
+        # the golden features (a second build would re-instantiate the
+        # ~0.5 GB VGG weights for nothing)
+        km = model.keras_builder()(weights="imagenet")
         wpath = os.path.join(args.weights_dir, f"{name}.npz")
-        save_named_params(name, wpath, weights="imagenet")
+        save_params_npz(params_from_keras(km), wpath)
 
         # keras ground truth: seeded uint8 RGB input at native geometry,
-        # keras's OWN preprocess_input, real weights, avg-pooled features
+        # keras's OWN preprocess_input, real weights, cut at the SAME
+        # layer DeepImageFeaturizer outputs (model.feature_cut — the
+        # registry's one definition: avg-pooled penultimate for the conv
+        # nets, post-relu fc2 (4096-d) for VGG; a pooling='avg' no-top
+        # build here would record 512-d VGG goldens the 4096-d
+        # featurizer could never match)
         rng = np.random.default_rng(GOLDEN_SEED)
         x = rng.integers(0, 256, size=(GOLDEN_BATCH, h, w, 3),
                          dtype=np.uint8)
-        km = model.keras_builder()(weights="imagenet", include_top=False,
-                                   pooling="avg")
-        mod = getattr(keras.applications, _keras_module(name))
-        feats = km.predict(mod.preprocess_input(x.astype(np.float32)),
-                           verbose=0).astype(np.float32)
+        feat_km = keras.Model(km.input,
+                              km.get_layer(model.feature_cut).output)
+        mod = getattr(keras.applications, model.keras_module)
+        feats = feat_km.predict(mod.preprocess_input(x.astype(np.float32)),
+                                verbose=0).astype(np.float32)
         gpath = os.path.join(args.goldens_dir, f"{name}_imagenet.npz")
         np.savez_compressed(
             gpath,
@@ -82,16 +91,6 @@ def main():
         print(f"{name}: golden {gpath} ({os.path.getsize(gpath)} bytes), "
               f"weights {wpath} ({os.path.getsize(wpath) >> 20} MB)",
               flush=True)
-
-
-def _keras_module(name: str) -> str:
-    return {
-        "InceptionV3": "inception_v3",
-        "Xception": "xception",
-        "ResNet50": "resnet50",
-        "VGG16": "vgg16",
-        "VGG19": "vgg19",
-    }[name]
 
 
 if __name__ == "__main__":

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Per-op device-time attribution for the judged featurize program.
+
+Round-3 verdict item 2: "nothing in the repo says where the other 80%
+goes" — the compute-only MFU number needs a profile behind it. This tool
+runs the SAME program ``bench.py:measure_compute_only`` times (InceptionV3
+featurize, input device-resident) under ``tpudl.obs.profile`` and parses
+the resulting trace-viewer JSON, which the axon/PJRT backend populates
+with real device-side lanes:
+
+- "XLA Modules" lane → the compiled program's on-device wall time per
+  step. This is the honest chip-side throughput/MFU, independent of
+  tunnel dispatch latency (which the wall-clock compute-only number
+  still pays between steps).
+- "XLA Ops" lane → every fused op's device time, name, HLO category,
+  bytes_accessed, and full HLO long_name (shapes included) — the
+  attribution table.
+
+Output: a markdown per-op table (top-K by device self-time) plus the
+module-level summary, printed to stdout; ``--out PROFILE.md`` rewrites
+the committed profile report. Works on the real chip; on CPU the trace
+has no XLA lanes and the tool says so instead of fabricating numbers.
+
+Usage:
+    python tools/profile_featurize.py [--batch 256] [--reps 4]
+        [--dtype bfloat16] [--out PROFILE.md]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_INCEPTION_FLOPS = 6e9          # fwd FLOPs per 299x299 image (bench.py)
+_V5E_PEAK_FLOPS = 197e12        # bf16 peak, TPU v5e
+
+
+def run_and_analyze(batch, dtype, reps):
+    """Trace the SHARED bench program (bench.build_featurize_step via
+    bench.profile_featurize_device — one definition, so this table and
+    the per-run ``device_profile`` record can never measure different
+    programs) and shape the summary for reporting."""
+    import bench
+
+    s, wall = bench.profile_featurize_device(batch, dtype, reps)
+    return {
+        "module_us_total": s["module_us"],
+        "module_count": s["module_count"],
+        "ops": s["ops"],
+        "batch": batch,
+        "reps": reps,
+        "wall_s": wall,
+    }
+
+
+_SHAPE_RE = re.compile(r"(?:bf16|f32|u8|s32|pred)\[[0-9,]*\]")
+
+
+def _op_desc(long_name: str) -> str:
+    """Compress an HLO long_name to 'out_shape = kind(arg shapes...)'."""
+    if not long_name:
+        return ""
+    shapes = _SHAPE_RE.findall(long_name)
+    kind = "fusion"
+    m = re.search(r"kind=k(\w+)", long_name)
+    if m:
+        kind = m.group(1)
+    elif "convolution" in long_name:
+        kind = "convolution"
+    out = shapes[0] if shapes else "?"
+    ins = ", ".join(shapes[1:4]) + ("…" if len(shapes) > 4 else "")
+    return f"{out} ← {kind}({ins})"
+
+
+def report(an, dtype, top=15):
+    lines = []
+    us_per_step = an["module_us_total"] / max(1, an["reps"])
+    dev_ips = an["batch"] / (us_per_step / 1e6) if us_per_step else 0.0
+    dev_mfu = dev_ips * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS
+    wall_ips = an["batch"] * an["reps"] / an["wall_s"]
+    lines.append(f"- program: InceptionV3 featurize, batch {an['batch']}, "
+                 f"{dtype}, {an['reps']} reps")
+    lines.append(f"- device time/step (XLA Modules lane): "
+                 f"**{us_per_step / 1e3:.2f} ms** → "
+                 f"**{dev_ips:,.0f} img/s ≈ {dev_mfu:.1%} MFU on-device**")
+    lines.append(f"- wall-clock (incl. tunnel dispatch): {wall_ips:,.0f} "
+                 f"img/s — the gap to device time is dispatch latency, "
+                 f"not chip time")
+    total_op_us = sum(v["us"] for v in an["ops"].values())
+    lines.append(f"- XLA Ops lane total: {total_op_us / an['reps'] / 1e3:.2f}"
+                 f" ms/step across {len(an['ops'])} distinct ops")
+    lines.append("")
+    lines.append("| rank | op | category | ms/step | % step | GB/s |")
+    lines.append("|---|---|---|---|---|---|")
+    ranked = sorted(an["ops"].items(), key=lambda kv: -kv[1]["us"])[:top]
+    for i, (name, rec) in enumerate(ranked):
+        us = rec["us"]
+        ms = us / an["reps"] / 1e3
+        pct = 100.0 * us / total_op_us if total_op_us else 0.0
+        gbps = (rec["bytes"] / 1e9) / (us / 1e6) if us else 0.0
+        desc = _op_desc(rec["long_name"])
+        lines.append(f"| {i + 1} | `{name}` {desc} | {rec['category']} | "
+                     f"{ms:.3f} | {pct:.1f}% | {gbps:.0f} |")
+    return "\n".join(lines), {"device_ms_per_step": us_per_step / 1e3,
+                              "device_images_per_sec": dev_ips,
+                              "device_mfu": dev_mfu,
+                              "wall_images_per_sec": wall_ips}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=4)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--out", default=None,
+                    help="also append the report to this markdown file")
+    args = ap.parse_args()
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print("default backend is not TPU — the trace would have no XLA "
+              "device lanes; run this against the real chip.",
+              file=sys.stderr)
+
+    an = run_and_analyze(args.batch, args.dtype, args.reps)
+    if not an["module_count"]:
+        print("no TPU device lanes in the trace (CPU backend?) — nothing "
+              "to attribute", file=sys.stderr)
+        sys.exit(1)
+    md, summary = report(an, args.dtype, args.top)
+    print(md)
+    print(json.dumps({k: round(v, 2) if isinstance(v, float) else v
+                      for k, v in summary.items()}), file=sys.stderr)
+    if args.out:
+        stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+        with open(args.out, "a") as f:
+            f.write(f"\n## Capture {stamp} (batch {args.batch}, "
+                    f"{args.dtype})\n\n{md}\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,23 +11,84 @@ trace viewer), and a throughput meter that computes the judged metric
 from __future__ import annotations
 
 import contextlib
+import glob
+import gzip
 import json
+import os
 import time
 
 import jax
 
-__all__ = ["profile", "named_scope", "Meter"]
+__all__ = ["profile", "named_scope", "Meter", "load_trace_events",
+           "summarize_device_trace"]
 
 
 @contextlib.contextmanager
 def profile(log_dir: str):
     """Capture a jax.profiler trace for the enclosed block; view with
-    tensorboard-plugin-profile or xprof against ``log_dir``."""
+    tensorboard-plugin-profile or xprof against ``log_dir``, or parse
+    programmatically with :func:`load_trace_events` +
+    :func:`summarize_device_trace`."""
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def load_trace_events(trace_dir: str) -> list[dict]:
+    """Events from the newest trace-viewer JSON under ``trace_dir``
+    (written by :func:`profile`; works for tunneled backends too — the
+    PJRT plugin populates real device lanes)."""
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(max(paths, key=os.path.getmtime)) as f:
+        tr = json.load(f)
+    return tr["traceEvents"] if isinstance(tr, dict) else tr
+
+
+def summarize_device_trace(events: list[dict]) -> dict:
+    """Aggregate DEVICE-side time from a trace-viewer event list.
+
+    Returns ``{"module_us": total_us_across_XLA-Module_executions,
+    "module_count": n, "ops": {name: {us, count, category, long_name,
+    bytes}}}``. The "XLA Modules" lane is the compiled program's
+    on-device wall time — the honest chip-side throughput denominator,
+    independent of host/tunnel dispatch latency; the "XLA Ops" lane is
+    the per-fusion attribution (SURVEY.md §5.1). Empty summary (count 0)
+    when the trace has no TPU lanes (CPU backend)."""
+    procs, lanes = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            procs[e["pid"]] = e["args"].get("name", "")
+        elif e.get("name") == "thread_name":
+            lanes[(e["pid"], e["tid"])] = e["args"].get("name", "")
+    device_pids = {p for p, n in procs.items() if "TPU" in (n or "")}
+    module_us, module_count = 0.0, 0
+    ops: dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        lane = lanes.get((e["pid"], e["tid"]), "")
+        if lane == "XLA Modules":
+            module_us += e.get("dur", 0.0)
+            module_count += 1
+        elif lane == "XLA Ops":
+            a = e.get("args", {})
+            rec = ops.setdefault(e["name"], {
+                "us": 0.0, "count": 0, "category": "", "long_name": "",
+                "bytes": 0})
+            rec["us"] += e.get("dur", 0.0)
+            rec["count"] += 1
+            rec["category"] = a.get("hlo_category", rec["category"])
+            rec["long_name"] = a.get("long_name", rec["long_name"])
+            rec["bytes"] += int(a.get("bytes_accessed", 0) or 0)
+    return {"module_us": module_us, "module_count": module_count,
+            "ops": ops}
 
 
 named_scope = jax.named_scope  # label pipeline stages inside jitted code

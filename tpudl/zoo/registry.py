@@ -45,6 +45,26 @@ class NamedModel:
     preprocess_mode: str
     classes: int = 1000
 
+    @property
+    def keras_module(self) -> str:
+        """keras.applications submodule name (its preprocess_input is the
+        golden-generation oracle)."""
+        return {
+            "InceptionV3": "inception_v3",
+            "Xception": "xception",
+            "ResNet50": "resnet50",
+            "VGG16": "vgg16",
+            "VGG19": "vgg19",
+        }[self.name]
+
+    @property
+    def feature_cut(self) -> str:
+        """Keras layer whose output IS the DeepImageFeaturizer vector —
+        the ONE definition the golden generator and the harness
+        self-check must both cut at (post-relu fc2 for VGG, avg_pool for
+        the conv nets; mirrors :meth:`featurize`)."""
+        return "fc2" if self.name.startswith("VGG") else "avg_pool"
+
     # -- params ----------------------------------------------------------
     def init(self, rng, *, image_size: tuple[int, int] | None = None,
              include_top: bool = True) -> dict:
