@@ -163,13 +163,25 @@ def measure_featurize(n, batch, dtype, trials=5):
     serial = statistics.median(arms["serial"])
     spread = ((max(arms["prefetch"]) - min(arms["prefetch"])) / value
               if value else 0.0)
+    # drift-free arm comparison: each trial's rate NORMALIZED by its own
+    # contemporaneous wire ceiling — raw medians confound the A/B with
+    # link weather when the tunnel swings within a session
+    eff = {arm: [p["images_per_sec"] / p["wire_bound_images_per_sec"]
+                 for p in pairs
+                 if p["arm"] == arm and p["wire_bound_images_per_sec"]]
+           for arm in arms}
+    eff_med = {arm: (round(statistics.median(v), 3) if v else None)
+               for arm, v in eff.items()}
     log(f"featurize interleaved medians: prefetch {value:.1f}, serial "
-        f"{serial:.1f} img/s/chip (prefetch spread {spread:.0%})")
+        f"{serial:.1f} img/s/chip (prefetch spread {spread:.0%}); "
+        f"wire-normalized efficiency prefetch {eff_med['prefetch']} vs "
+        f"serial {eff_med['serial']}")
 
     return {"value": round(value, 2),
             "trials": [round(r, 1) for r in arms["prefetch"]],
             "serial_trials": [round(r, 1) for r in arms["serial"]],
             "interleaved_pairs": pairs,
+            "wire_normalized_efficiency": eff_med,
             "spread_pct": round(100 * spread, 1),
             "serial_infeed_images_per_sec": round(serial, 1),
             "warmup_seconds": round(warmup_s, 1)}
@@ -246,29 +258,94 @@ def build_featurize_step(batch, dtype):
     return step, params, xd
 
 
-def profile_featurize_device(batch, dtype, reps=4):
-    """Warm the shared featurize step, run ``reps`` chained iterations
-    under a jax.profiler trace, and return (device-trace summary,
-    wall_seconds). The summary's "XLA Modules" time is the program's
-    ON-DEVICE wall time — free of tunnel dispatch latency."""
-    import tempfile as _tf
-
+def build_resnet_train_step(batch, dtype):
+    """THE profiled TRAINING program — the HorovodRunner bench's ResNet50
+    SGD step (uint8 input, device-normalized) with device-resident data,
+    shaped for chained profiling: returns (step, carry, (xd, yd)) where
+    ``step(carry, x, y) -> (carry', loss)``."""
+    import jax
     import jax.numpy as jnp
+    import optax
+
+    from tpudl.zoo.registry import cast_params, getKerasApplicationModel
+
+    model = getKerasApplicationModel("ResNet50")
+    params = model.init(0)
+    if dtype != "float32":
+        params = cast_params(params, dtype)
+
+    def loss_fn(p, x, y):
+        x = (x.astype(jnp.dtype(dtype)) - 127.5) / 127.5
+        logits = model.predict(p, x)
+        logp = jnp.log(jnp.clip(logits, 1e-7, 1.0))
+        return -jnp.mean(jnp.sum(y * logp, axis=-1))
+
+    opt = optax.sgd(0.05)
+
+    @jax.jit
+    def step(carry, x, y):
+        p, o = carry
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        up, o = opt.update(g, o, p)
+        return (optax.apply_updates(p, up), o), loss
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(batch, 224, 224, 3), dtype=np.uint8)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    carry = jax.device_put((params, opt.init(params)))
+    xd, yd = jax.block_until_ready(jax.device_put((x, y)))
+    return step, carry, (xd, yd)
+
+
+def _profile_device(run_reps, reps):
+    """Trace ``run_reps(reps)`` (which must END with one data-dependent
+    host fetch) and return (device-trace summary, wall_seconds). The
+    summary's "XLA Modules" time is on-device wall time — free of
+    tunnel dispatch latency."""
+    import tempfile as _tf
 
     from tpudl.obs import load_trace_events, profile, summarize_device_trace
 
-    step, params, xd = build_featurize_step(batch, dtype)
-    float(step(params, xd))  # compile + warm
     with _tf.TemporaryDirectory(prefix="tpudl_prof_") as d:
         t0 = time.perf_counter()
         with profile(d):
-            acc = jnp.zeros((), jnp.float32)
-            for _ in range(reps):
-                acc = acc + step(params, xd)
-            float(acc)  # one data-dependent fetch drains the queue
+            run_reps(reps)
         wall = time.perf_counter() - t0
         s = summarize_device_trace(load_trace_events(d))
     return s, wall
+
+
+def profile_featurize_device(batch, dtype, reps=4):
+    """Warm the shared featurize step, run ``reps`` chained iterations
+    under a jax.profiler trace → (device summary, wall_s)."""
+    import jax.numpy as jnp
+
+    step, params, xd = build_featurize_step(batch, dtype)
+    float(step(params, xd))  # compile + warm
+
+    def run(reps):
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(reps):
+            acc = acc + step(params, xd)
+        float(acc)  # one data-dependent fetch drains the queue
+
+    return _profile_device(run, reps)
+
+
+def profile_train_device(batch, dtype, reps=4):
+    """Same, for the ResNet50 train step: ``reps`` chained SGD steps
+    (the carry is the data dependency) → (device summary, wall_s)."""
+    step, carry, (xd, yd) = build_resnet_train_step(batch, dtype)
+    carry, loss = step(carry, xd, yd)  # compile + warm
+    float(loss)
+
+    def run(reps):
+        c, l = carry, loss
+        for _ in range(reps):
+            c, l = step(c, xd, yd)
+        float(l)  # drains the chained steps
+
+    return _profile_device(run, reps)
 
 
 def measure_device_profile(batch, dtype, reps=4):
@@ -676,6 +753,67 @@ def measure_flash_attention():
     return out
 
 
+def measure_healthy_channel_e2e(batch, dtype, n_batches=4):
+    """End-to-end featurize in the tunnel's STREAMING mode — must run
+    FIRST, before any device→host read in the process.
+
+    Round-4 discovery (isolation experiments, BASELINE.md): before the
+    process's first device→host read, uploads stream through the tunnel
+    daemon's buffer fully pipelined (client-side put rates of 300–1500
+    MB/s are the daemon absorbing at memory speed; true delivery rides
+    the wire behind the scenes). After ANY first fetch — sync, async,
+    device_get, scalar or buffer — the client permanently switches to
+    per-transfer synchronization, adding round-trip overhead on top of
+    the wire (measured puts drop to 3–20 MB/s). Executions alone do not
+    trigger the switch. All previous rounds' e2e numbers are post-fetch
+    mode, because compile warmup fetched a value.
+
+    This measurement compiles AOT (``.lower().compile()`` — no
+    execution, no fetch), streams + executes ``n_batches`` exactly like
+    ``map_batches`` acc-mode (one materialization at the end), and
+    times everything INCLUDING the final fetch, which is where the
+    pipelined uploads actually drain. Same-night comparison: ~1.6–1.9×
+    the post-fetch trial rate — the gain is pipelining, not magic
+    bandwidth. ``enqueue_seconds`` (before any await) and
+    ``blocked_seconds`` (after block_until_ready, which this backend
+    has been observed to release early) are kept to show the
+    enqueue/delivery asymmetry against the fetched total."""
+    import jax
+    import jax.numpy as jnp
+
+    step, params, xd = build_featurize_step(batch, dtype)
+    lowered = step.lower(params, xd)
+    compiled = lowered.compile()  # AOT: no execution, no fetch
+    del xd
+    rng = np.random.default_rng(1)
+    hosts = [rng.integers(0, 256, size=(batch, 299, 299, 3),
+                          dtype=np.uint8) for _ in range(n_batches)]
+    # one warm execution, result left on device (block, never read)
+    jax.block_until_ready(compiled(params, jax.device_put(hosts[0])))
+
+    t0 = time.perf_counter()
+    outs = []
+    for x in hosts:
+        outs.append(compiled(params, jax.device_put(x)))
+    t_enq = time.perf_counter() - t0      # true enqueue (nothing awaited)
+    jax.block_until_ready(outs)
+    t_blocked = time.perf_counter() - t0  # after block (may still under-
+    # report on this backend: block_until_ready has been observed to
+    # return before the tunnel truly delivers; the fetch below is the
+    # only honest barrier)
+    total = float(sum(outs))  # the ONE fetch (device-side add chain)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(total)
+    n = batch * n_batches
+    log(f"streaming-mode e2e: {n} images in {dt:.2f}s "
+        f"(enqueue {t_enq:.2f}s, blocked {t_blocked:.2f}s) -> "
+        f"{n / dt:.1f} img/s/chip (pre-first-fetch pipelined mode)")
+    return {"images_per_sec": round(n / dt, 1),
+            "enqueue_seconds": round(t_enq, 2),
+            "blocked_seconds": round(t_blocked, 2),
+            "n_images": n, "batch": batch}
+
+
 def measure_wire_bandwidth(mb=64):
     """Raw host→device and device→host bandwidth of the backend link,
     measured with a bare device_put / device_get of one contiguous
@@ -732,8 +870,10 @@ def measure_tf_cpu_baseline(k=64, batch=32, trials=3):
     return {"value": value, "trials": [round(r, 3) for r in rates]}
 
 
-# InceptionV3 forward ≈ 6 GFLOPs/image; TPU v5e peak ≈ 197 bf16 TFLOP/s.
+# InceptionV3 forward ≈ 6 GFLOPs/image; ResNet50 forward ≈ 4.1 GFLOPs
+# (train ≈ 3× forward); TPU v5e peak ≈ 197 bf16 TFLOP/s.
 _INCEPTION_FLOPS = 6e9
+_RESNET50_TRAIN_FLOPS = 3 * 4.1e9
 _V5E_PEAK_FLOPS = 197e12
 
 
@@ -765,19 +905,33 @@ def main():
     }
     _start_watchdog(extra)
 
+    if devs[0].platform == "tpu":
+        try:
+            # MUST be first: valid only before the process's first
+            # device->host read (see measure_healthy_channel_e2e)
+            extra["streaming_mode_e2e"] = measure_healthy_channel_e2e(
+                batch, dtype)
+        except Exception as e:
+            log(f"streaming-mode sub-bench failed: {e!r}")
+
     feat = measure_featurize(n, batch, dtype, trials)
     extra.update({
         "value": feat["value"],
         "featurize_trials": feat["trials"],
         "featurize_serial_trials": feat["serial_trials"],
         "featurize_interleaved_pairs": feat["interleaved_pairs"],
+        "featurize_wire_normalized_efficiency":
+            feat["wire_normalized_efficiency"],
         "featurize_spread_pct": feat["spread_pct"],
         "serial_infeed_images_per_sec": feat["serial_infeed_images_per_sec"],
         "compile_warmup_seconds": feat["warmup_seconds"],
     })
     try:
+        # batch 256 profiled BEST for device MFU (PROFILE.md sweep:
+        # 256→22.8%, 1024→20.4%) and its 68 MB device_put is 4× less
+        # likely to wedge a degraded tunnel than 1024's 274 MB
         compute_batch = int(os.environ.get("TPUDL_BENCH_COMPUTE_BATCH",
-                                           "1024"))
+                                           "256"))
         compute_ips = measure_compute_only(compute_batch, dtype)
         extra["compute_only_images_per_sec"] = round(compute_ips, 1)
         extra["compute_only_batch"] = compute_batch

@@ -177,6 +177,59 @@ def test_from_keras_trainable_matches_and_differentiates(keras_mlp):
     assert total > 0
 
 
+def test_make_graph_udf_from_keras(keras_mlp):
+    """makeGraphUDF parity (ref: graph/tensorframes_udf.py ~L20): an
+    ingested keras graph registers as a SQL-callable UDF; the mapped
+    column feeds the graph input and the fetch lands in '<name>_out'."""
+    from tpudl.frame import Frame, sql
+    from tpudl.udf import makeGraphUDF, registry
+
+    x = np.random.default_rng(4).normal(size=(8, 4)).astype(np.float32)
+    want = keras_mlp.predict(x, verbose=0)
+    gin = TFInputGraph.fromKeras(keras_mlp)
+    try:
+        udf = makeGraphUDF(gin, "mlp_udf",
+                           feeds_to_fields_map={gin.input_names[0]: "x"})
+        assert udf.input_col == "x"
+        rows = np.empty(len(x), dtype=object)
+        rows[:] = list(x)
+        out = sql("SELECT mlp_udf(x) AS y FROM t", {"t": Frame({"x": rows})})
+        got = np.stack(list(out["y"]))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    finally:
+        registry.unregister_udf("mlp_udf")
+
+    # register=False returns a working UDF without touching the registry
+    udf2 = makeGraphUDF(gin, "unfiled", register=False,
+                        feeds_to_fields_map={gin.input_names[0]: "x"})
+    assert "unfiled" not in registry.list_udfs()
+    rows2 = np.empty(len(x), dtype=object)
+    rows2[:] = list(x)
+    got2 = np.stack(list(udf2(Frame({"x": rows2}))["unfiled_out"]))
+    np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+
+
+def test_make_graph_udf_from_graph_function():
+    """GraphFunction route + bad-graph type error."""
+    import jax.numpy as jnp
+
+    from tpudl.frame import Frame
+    from tpudl.ingest.builder import GraphFunction
+    from tpudl.udf import makeGraphUDF
+
+    gf = GraphFunction(lambda a: jnp.tanh(a), ["x"], ["y"])
+    udf = makeGraphUDF(gf, "tanh_udf", register=False)
+    data = np.linspace(-1, 1, 12).astype(np.float32)
+    out = udf(Frame({"x": data}))
+    np.testing.assert_allclose(np.asarray(list(out["tanh_udf_out"]),
+                                          dtype=np.float32),
+                               np.tanh(data), rtol=1e-6)
+    with pytest.raises(TypeError, match="GraphFunction"):
+        makeGraphUDF(object(), "bad")
+    with pytest.raises(ValueError, match="fetches"):
+        makeGraphUDF(gf, "bad", fetches=["y:0"])
+
+
 def test_keras_cnn_op_coverage():
     """Conv2D/DepthwiseConv2D/BN/pooling/flatten through the translator."""
     import keras

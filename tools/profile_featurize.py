@@ -35,18 +35,17 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_INCEPTION_FLOPS = 6e9          # fwd FLOPs per 299x299 image (bench.py)
-_V5E_PEAK_FLOPS = 197e12        # bf16 peak, TPU v5e
 
-
-def run_and_analyze(batch, dtype, reps):
-    """Trace the SHARED bench program (bench.build_featurize_step via
-    bench.profile_featurize_device — one definition, so this table and
-    the per-run ``device_profile`` record can never measure different
-    programs) and shape the summary for reporting."""
+def run_and_analyze(program, batch, dtype, reps):
+    """Trace the SHARED bench program (bench.build_featurize_step /
+    bench.build_resnet_train_step via bench.profile_*_device — one
+    definition, so this table and the per-run ``device_profile`` record
+    can never measure different programs) and shape the summary."""
     import bench
 
-    s, wall = bench.profile_featurize_device(batch, dtype, reps)
+    runner = (bench.profile_featurize_device if program == "featurize"
+              else bench.profile_train_device)
+    s, wall = runner(batch, dtype, reps)
     return {
         "module_us_total": s["module_us"],
         "module_count": s["module_count"],
@@ -76,13 +75,28 @@ def _op_desc(long_name: str) -> str:
     return f"{out} ← {kind}({ins})"
 
 
-def report(an, dtype, top=15):
+def _program_info(program):
+    """description + FLOPs/image from bench's single definitions."""
+    import bench
+
+    return {
+        "featurize": ("InceptionV3 featurize", bench._INCEPTION_FLOPS),
+        "train": ("ResNet50 SGD train step (fwd+bwd+update)",
+                  bench._RESNET50_TRAIN_FLOPS),
+    }[program]
+
+
+def report(an, program, dtype, top=15):
+    import bench
+
+    desc, flops_per_img = _program_info(program)
+    peak = bench._V5E_PEAK_FLOPS
     lines = []
     us_per_step = an["module_us_total"] / max(1, an["reps"])
     dev_ips = an["batch"] / (us_per_step / 1e6) if us_per_step else 0.0
-    dev_mfu = dev_ips * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS
+    dev_mfu = dev_ips * flops_per_img / peak
     wall_ips = an["batch"] * an["reps"] / an["wall_s"]
-    lines.append(f"- program: InceptionV3 featurize, batch {an['batch']}, "
+    lines.append(f"- program: {desc}, batch {an['batch']}, "
                  f"{dtype}, {an['reps']} reps")
     lines.append(f"- device time/step (XLA Modules lane): "
                  f"**{us_per_step / 1e3:.2f} ms** → "
@@ -113,6 +127,8 @@ def report(an, dtype, top=15):
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--program", choices=("featurize", "train"),
+                    default="featurize")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--reps", type=int, default=4)
     ap.add_argument("--dtype", default="bfloat16")
@@ -128,20 +144,20 @@ def main():
               "device lanes; run this against the real chip.",
               file=sys.stderr)
 
-    an = run_and_analyze(args.batch, args.dtype, args.reps)
+    an = run_and_analyze(args.program, args.batch, args.dtype, args.reps)
     if not an["module_count"]:
         print("no TPU device lanes in the trace (CPU backend?) — nothing "
               "to attribute", file=sys.stderr)
         sys.exit(1)
-    md, summary = report(an, args.dtype, args.top)
+    md, summary = report(an, args.program, args.dtype, args.top)
     print(md)
     print(json.dumps({k: round(v, 2) if isinstance(v, float) else v
                       for k, v in summary.items()}), file=sys.stderr)
     if args.out:
         stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
         with open(args.out, "a") as f:
-            f.write(f"\n## Capture {stamp} (batch {args.batch}, "
-                    f"{args.dtype})\n\n{md}\n")
+            f.write(f"\n## Capture {stamp} ({args.program}, batch "
+                    f"{args.batch}, {args.dtype})\n\n{md}\n")
 
 
 if __name__ == "__main__":

@@ -1,2 +1,3 @@
 from tpudl.udf import registry  # noqa: F401
 from tpudl.udf.registry import get_udf, list_udfs, register_udf  # noqa: F401
+from tpudl.udf.tensorframes_udf import makeGraphUDF  # noqa: F401

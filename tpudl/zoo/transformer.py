@@ -80,8 +80,19 @@ class TinyCausalLM:
         return params
 
     # -- forward ----------------------------------------------------------
-    def apply(self, params, tokens, *, mesh=None, use_pallas: bool = False):
-        """tokens [B, S] int32 → logits [B, S, vocab]."""
+    def apply(self, params, tokens, *, mesh=None, use_pallas: bool = False,
+              remat: bool = False):
+        """tokens [B, S] int32 → logits [B, S, vocab].
+
+        ``remat=True`` wraps each decoder block in ``jax.checkpoint``:
+        the backward pass recomputes block activations instead of
+        holding them, so training-time activation HBM drops from
+        O(layers · B · S · D) to O(B · S · D) + one block — the standard
+        TPU long-context trade (FLOPs are cheap on the MXU, HBM is not).
+        Composes with the ring path (shard_map/ppermute are rematable —
+        under ``jax.jit``, as the Trainer always runs; eager
+        checkpoint-of-shard_map is unsupported upstream) and the Pallas
+        kernels (the custom VJP re-runs the tiled forward)."""
         from tpudl.attention import attention_reference, ring_attention
 
         b, s = tokens.shape
@@ -89,16 +100,18 @@ class TinyCausalLM:
             raise ValueError(
                 f"sequence length {s} exceeds max_len {self.max_len}")
         x = params["embed"]["table"][tokens]              # [B, S, D]
+
         # rotary-free: learned-position-less (relative order comes from
         # the causal mask; adequate for the convergence tests this
         # model exists for, and keeps the ring path position-agnostic)
-        for i in range(self.layers):
-            p = params[f"block_{i}"]
+        def block(x, p):
             h = _layer_norm(x, {"gamma": p["norm1_gamma"],
                                 "beta": p["norm1_beta"]})
             q, k, v = (h @ p[w] for w in ("wq", "wk", "wv"))
+
             def split(t):
                 return t.reshape(b, s, self.heads, self.dim // self.heads)
+
             q, k, v = split(q), split(k), split(v)
             if mesh is not None:
                 att = ring_attention(q, k, v, mesh, causal=True,
@@ -115,19 +128,26 @@ class TinyCausalLM:
             h = _layer_norm(x, {"gamma": p["norm2_gamma"],
                                 "beta": p["norm2_beta"]})
             h = jax.nn.gelu(h @ p["w_up"] + p["b_up"])
-            x = x + h @ p["w_down"] + p["b_down"]
+            return x + h @ p["w_down"] + p["b_down"]
+
+        if remat:
+            block = jax.checkpoint(block)
+        for i in range(self.layers):
+            x = block(x, params[f"block_{i}"])
         x = _layer_norm(x, params["final_norm"])
         return x @ params["embed"]["table"].T              # tied head
 
     # -- training loss -----------------------------------------------------
-    def loss_fn(self, *, mesh=None, use_pallas: bool = False):
+    def loss_fn(self, *, mesh=None, use_pallas: bool = False,
+                remat: bool = False):
         """``loss(params, tokens)``: next-token cross-entropy, mean over
         the global batch (the allreduce contraction —
-        tpudl.train.make_train_step turns it into the ICI psum)."""
+        tpudl.train.make_train_step turns it into the ICI psum).
+        ``remat=True`` checkpoints each block (see :meth:`apply`)."""
 
         def loss(params, tokens):
             logits = self.apply(params, tokens[:, :-1], mesh=mesh,
-                                use_pallas=use_pallas)
+                                use_pallas=use_pallas, remat=remat)
             targets = tokens[:, 1:]
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             picked = jnp.take_along_axis(
