@@ -19,9 +19,14 @@ this host — Keras/TF InceptionV3 inference on CPU (the reference
 publishes no numbers, BASELINE.md; we measure both sides ourselves).
 
 Env knobs: TPUDL_BENCH_SKIP_BASELINE=1 skips the TF-CPU side;
-TPUDL_BENCH_QUICK=1 runs the headline config only; TPUDL_BENCH_N /
-_BATCH / _TRIALS resize the featurize run; TPUDL_BENCH_DTYPE picks the
-compute precision. Everything except the final JSON line goes to stderr.
+TPUDL_BENCH_QUICK=1 runs the headline config only (and shrinks the
+streaming phase to 1 trial/arm); TPUDL_BENCH_N / _BATCH / _TRIALS
+resize the featurize run; TPUDL_BENCH_DTYPE picks the compute
+precision. Streaming-phase knobs: TPUDL_BENCH_STREAM_TRIALS (per-arm
+subprocess trials, 0 disables), TPUDL_BENCH_STREAM_BUDGET_S (stop
+starting trials past this wall-clock), TPUDL_BENCH_TRIAL_TIMEOUT_S
+(per-subprocess kill). TPUDL_BENCH_DEADLINE_S bounds the whole run.
+Everything except the final JSON line goes to stderr.
 """
 
 import json
@@ -58,13 +63,16 @@ def _start_watchdog(record: dict):
     the PJRT client with zero CPU). The watchdog guarantees the driver
     ALWAYS gets a JSON line: at the deadline it emits whatever has been
     measured so far (flagged ``deadline_hit``) and exits."""
-    deadline = float(os.environ.get("TPUDL_BENCH_DEADLINE_S", "2700"))
+    deadline = float(os.environ.get("TPUDL_BENCH_DEADLINE_S", "3300"))
 
     def run():
         time.sleep(deadline)
         if not _EMITTED.is_set():
             log(f"bench deadline {deadline:.0f}s hit — emitting partial "
                 "record and exiting (a backend RPC is likely wedged)")
+            child = _ACTIVE_CHILD.get("proc")
+            if child is not None and child.poll() is None:
+                child.kill()  # orphan would keep holding the chip
             partial = dict(record)
             partial.setdefault("value", None)
             partial["deadline_hit"] = True
@@ -88,6 +96,168 @@ def make_frame(n, h=299, w=299, seed=0):
     return Frame({"image": structs})
 
 
+def run_featurize_trial(arm, n, batch, dtype):
+    """Subprocess body for ONE streaming-mode featurize trial (invoked
+    as ``bench.py --featurize-trial <arm> <n> <batch> <dtype>``).
+
+    A fresh process starts in the tunnel's pipelined STREAMING mode and
+    stays there until its first device→host read (BASELINE.md "two
+    transfer modes"). The product path preserves that mode by
+    construction: ``DeepImageFeaturizer.warmup`` compiles and warms
+    without fetching, and ``transform`` (map_batches acc-mode) fetches
+    exactly ONCE at the end — so the whole timed transform runs with
+    every upload pipelined, and the single final fetch (where the
+    uploads actually drain) is INSIDE the timed window. This is the rate
+    a real user sees on a fresh process: load → transform → read.
+
+    The wire probe runs AFTER the transform (the transform's fetch has
+    flipped the process to synchronized mode by then) — a pre-trial
+    probe in streaming mode would only measure the daemon's absorption
+    rate, not the wire. Emits one JSON line on stdout."""
+    from tpudl.compilation_cache import enable_compilation_cache
+    from tpudl.ml import DeepImageFeaturizer
+
+    enable_compilation_cache()
+    os.environ["TPUDL_FRAME_PREFETCH"] = "1" if arm == "prefetch" else "0"
+    feat = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                               modelName="InceptionV3", batchSize=batch,
+                               computeDtype=dtype)
+    t0 = time.perf_counter()
+    feat.warmup(299, 299)  # compile + one execution; nothing fetched
+    warm_s = time.perf_counter() - t0
+    frame = make_frame(n)
+    t0 = time.perf_counter()
+    out = feat.transform(frame)
+    np.asarray(out["features"][-1])  # already host; paranoia barrier
+    dt = time.perf_counter() - t0
+    rec = {"arm": arm, "images_per_sec": round(n / dt, 1),
+           "transform_seconds": round(dt, 2),
+           "warmup_seconds": round(warm_s, 1), "n": n, "batch": batch}
+    try:
+        bw = measure_wire_bandwidth(mb=8)
+        rec["h2d_mb_per_sec_post"] = bw["h2d_mb_per_sec"]
+        img_mb = 299 * 299 * 3 / 2**20
+        rec["sync_wire_bound_images_per_sec"] = round(
+            bw["h2d_mb_per_sec"] / img_mb, 1)
+    except Exception as e:
+        log(f"trial wire probe failed: {e!r}")
+    print(json.dumps(rec), flush=True)
+
+
+_ACTIVE_CHILD: dict = {}  # watchdog kills this on deadline
+
+
+def measure_featurize_streaming(n, batch, dtype, per_arm=4, extra=None):
+    """Headline configs[0] measured the way the product actually runs on
+    a fresh process: each trial is its OWN subprocess (warmup without
+    fetch → one timed transform → one final fetch), so every trial gets
+    the tunnel's pipelined streaming mode — the committed two-mode model
+    says in-process repeat trials can never see it. Trials alternate
+    prefetch/serial (counterbalanced) and each carries a post-transform
+    wire probe, so the record keeps the drift-visible (arm, rate,
+    contemporaneous sync-mode ceiling) pairs. The persistent XLA
+    compilation cache makes subprocess compile costs one-time."""
+    import subprocess
+
+    timeout = float(os.environ.get("TPUDL_BENCH_TRIAL_TIMEOUT_S", "450"))
+    # stop STARTING new trials past this wall-clock budget so the phase
+    # can never out-run the watchdog deadline on a degraded tunnel
+    budget = float(os.environ.get("TPUDL_BENCH_STREAM_BUDGET_S", "1500"))
+    phase_start = time.perf_counter()
+    arms = {"prefetch": [], "serial": []}
+    pairs, failures = [], []
+    # live record: visible to the watchdog's partial emit from the first
+    # completed trial on (the "every sub-bench writes in as soon as it
+    # completes" contract)
+    out = {"trials": [], "serial_trials": [], "interleaved_pairs": pairs}
+    if extra is not None:
+        extra["featurize_streaming"] = out
+    budget_hit = False
+    for t in range(per_arm):
+        order = (("prefetch", "serial") if t % 2 == 0
+                 else ("serial", "prefetch"))
+        for arm in order:
+            elapsed = time.perf_counter() - phase_start
+            if elapsed > budget and (arms["prefetch"] or t > 0):
+                budget_hit = True
+                break
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--featurize-trial", arm, str(n), str(batch), dtype]
+            try:
+                t0 = time.perf_counter()
+                proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                        stderr=subprocess.PIPE, text=True)
+                _ACTIVE_CHILD["proc"] = proc
+                stdout, stderr = proc.communicate(timeout=timeout)
+                wall = time.perf_counter() - t0
+                sys.stderr.write(stderr[-2000:])
+                rec = json.loads(stdout.strip().splitlines()[-1])
+            except Exception as e:
+                child = _ACTIVE_CHILD.pop("proc", None)
+                if child is not None and child.poll() is None:
+                    child.kill()  # single-process-per-chip: must not
+                    child.wait()  # leave an orphan holding the TPU
+                log(f"streaming trial {t} [{arm}] failed: {e!r}")
+                failures.append({"arm": arm, "error": repr(e)[:200]})
+                out["failed_trials"] = failures
+                continue
+            finally:
+                _ACTIVE_CHILD.pop("proc", None)
+            rec["subprocess_wall_seconds"] = round(wall, 1)
+            arms[arm].append(rec["images_per_sec"])
+            pairs.append(rec)
+            _update_streaming_summary(out, arms, extra)
+            log(f"streaming trial {t} [{arm}]: {rec['images_per_sec']} "
+                f"img/s (warmup {rec['warmup_seconds']}s, sync-mode "
+                f"ceiling {rec.get('sync_wire_bound_images_per_sec')}, "
+                f"subprocess {wall:.0f}s)")
+        if budget_hit:
+            log(f"streaming phase budget {budget:.0f}s reached after "
+                f"{len(pairs)} trials — not starting more")
+            out["budget_hit"] = True
+            break
+    if not arms["prefetch"] and not arms["serial"]:
+        # keep the failure evidence in the record (the phase RAN and
+        # failed N times — popping it would hide that); only the
+        # headline falls back to the in-process measurement
+        out["all_trials_failed"] = True
+        return None
+    return out
+
+
+def _update_streaming_summary(out, arms, extra):
+    """Recompute the streaming record's derived fields after each trial
+    (kept incremental so a watchdog partial emit carries them)."""
+    pairs = out["interleaved_pairs"]
+    out["trials"] = [round(r, 1) for r in arms["prefetch"]]
+    out["serial_trials"] = [round(r, 1) for r in arms["serial"]]
+    # the headline is the prefetch arm; if that arm produced nothing the
+    # serial median stands in and the record SAYS so — a silent
+    # substitution would misattribute serial rates to the prefetch path
+    if arms["prefetch"]:
+        out["value"] = round(statistics.median(arms["prefetch"]), 2)
+        out["headline_arm"] = "prefetch"
+    elif arms["serial"]:
+        out["value"] = round(statistics.median(arms["serial"]), 2)
+        out["headline_arm"] = "serial_fallback"
+    if arms["serial"]:
+        out["serial_median"] = round(statistics.median(arms["serial"]), 2)
+    # rate ÷ contemporaneous SYNC-mode wire ceiling: values > 1 are the
+    # pipelining win made visible (streaming mode beats what the
+    # synchronized wire could ever carry)
+    over = [p["images_per_sec"] / p["sync_wire_bound_images_per_sec"]
+            for p in pairs if p.get("sync_wire_bound_images_per_sec")]
+    if over:
+        out["rate_over_sync_ceiling_median"] = round(
+            statistics.median(over), 2)
+    if extra is not None and "value" in out:
+        extra["value"] = out["value"]
+        extra["headline_mode"] = ("streaming_fresh_process"
+                                  if out["headline_arm"] == "prefetch"
+                                  else "streaming_fresh_process_serial_"
+                                       "fallback")
+
+
 def measure_featurize(n, batch, dtype, trials=5):
     """Headline: configs[0], measured as an INTERLEAVED prefetch/serial
     A/B (round-3 verdict item 1): trials alternate
@@ -99,10 +269,11 @@ def measure_featurize(n, batch, dtype, trials=5):
     confound either claim. ``value`` is the prefetch-arm median."""
     from tpudl.ml import DeepImageFeaturizer
 
-    per_arm = max(1, trials)  # TPUDL_BENCH_TRIALS is per arm; <4 is a
-    if per_arm < 4:           # sanity run, honored but flagged
-        log(f"NOTE: {per_arm} trials/arm is below the 4-per-arm A/B "
-            "contract — treat this record as a smoke run")
+    per_arm = max(1, trials)  # TPUDL_BENCH_TRIALS is per arm; the
+    # ≥4-per-arm A/B contract lives on the STREAMING record now
+    # (measure_featurize_streaming) — this in-process synchronized-mode
+    # A/B is the cross-round-comparable secondary and may run shorter
+    log(f"synchronized-mode in-process A/B: {per_arm} trials/arm")
     feat = DeepImageFeaturizer(inputCol="image", outputCol="features",
                                modelName="InceptionV3", batchSize=batch,
                                computeDtype=dtype)
@@ -878,21 +1049,20 @@ _V5E_PEAK_FLOPS = 197e12
 
 
 def main():
-    import jax
-
-    from tpudl.compilation_cache import enable_compilation_cache
-
-    cache_dir = enable_compilation_cache()
-    devs = jax.devices()
-    log(f"backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind})")
-    log(f"persistent compile cache: {cache_dir or 'disabled'}")
     dtype = os.environ.get("TPUDL_BENCH_DTYPE", "bfloat16")
     log(f"compute dtype: {dtype} (standard TPU inference precision; "
         "set TPUDL_BENCH_DTYPE=float32 for full-precision numbers)")
     batch = int(os.environ.get("TPUDL_BENCH_BATCH", "256"))
     n = int(os.environ.get("TPUDL_BENCH_N", "1024"))
     n = max(batch, n - n % batch)  # whole batches, at least one
-    trials = int(os.environ.get("TPUDL_BENCH_TRIALS", "4"))  # per A/B arm
+    # per-arm counts: the ≥4-per-arm interleaved-A/B contract (round-3
+    # verdict item 1) now lives on the streaming record — the product's
+    # real fresh-process rate; the in-process synchronized A/B stays as
+    # the cross-round-comparable secondary at a reduced default
+    quick = os.environ.get("TPUDL_BENCH_QUICK", "0") == "1"
+    stream_trials = int(os.environ.get("TPUDL_BENCH_STREAM_TRIALS",
+                                       "1" if quick else "4"))
+    trials = int(os.environ.get("TPUDL_BENCH_TRIALS", "2"))
 
     # the watchdog emits this dict if a backend RPC wedges — every
     # sub-bench writes its result in as soon as it completes
@@ -905,10 +1075,37 @@ def main():
     }
     _start_watchdog(extra)
 
+    # 1) Streaming-mode subprocess trials FIRST, before this process
+    #    initializes its backend: TPU runtimes are single-process-per-
+    #    chip, so the parent must not hold the device while a trial
+    #    subprocess needs it. Each trial is a fresh process = fresh
+    #    streaming mode (see run_featurize_trial).
+    feat_stream = None
+    if stream_trials > 0:
+        try:
+            # writes value/headline_mode/featurize_streaming into
+            # ``extra`` incrementally as trials complete (watchdog-safe)
+            feat_stream = measure_featurize_streaming(n, batch, dtype,
+                                                      stream_trials,
+                                                      extra=extra)
+        except Exception as e:
+            log(f"streaming featurize sub-bench failed: {e!r}")
+
+    # 2) Only now bring up this process's backend.
+    import jax
+
+    from tpudl.compilation_cache import enable_compilation_cache
+
+    cache_dir = enable_compilation_cache()
+    devs = jax.devices()
+    log(f"backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind})")
+    log(f"persistent compile cache: {cache_dir or 'disabled'}")
+
     if devs[0].platform == "tpu":
         try:
-            # MUST be first: valid only before the process's first
-            # device->host read (see measure_healthy_channel_e2e)
+            # valid only before the parent's first device->host read —
+            # the subprocess trials above fetched in THEIR processes,
+            # not this one (see measure_healthy_channel_e2e)
             extra["streaming_mode_e2e"] = measure_healthy_channel_e2e(
                 batch, dtype)
         except Exception as e:
@@ -916,16 +1113,22 @@ def main():
 
     feat = measure_featurize(n, batch, dtype, trials)
     extra.update({
-        "value": feat["value"],
-        "featurize_trials": feat["trials"],
-        "featurize_serial_trials": feat["serial_trials"],
-        "featurize_interleaved_pairs": feat["interleaved_pairs"],
-        "featurize_wire_normalized_efficiency":
-            feat["wire_normalized_efficiency"],
-        "featurize_spread_pct": feat["spread_pct"],
-        "serial_infeed_images_per_sec": feat["serial_infeed_images_per_sec"],
+        "featurize_sync_mode": {
+            "value": feat["value"],
+            "trials": feat["trials"],
+            "serial_trials": feat["serial_trials"],
+            "interleaved_pairs": feat["interleaved_pairs"],
+            "wire_normalized_efficiency":
+                feat["wire_normalized_efficiency"],
+            "spread_pct": feat["spread_pct"],
+            "serial_infeed_images_per_sec":
+                feat["serial_infeed_images_per_sec"],
+        },
         "compile_warmup_seconds": feat["warmup_seconds"],
     })
+    if not feat_stream:
+        extra["value"] = feat["value"]
+        extra["headline_mode"] = "synchronized_in_process"
     try:
         # batch 256 profiled BEST for device MFU (PROFILE.md sweep:
         # 256→22.8%, 1024→20.4%) and its 68 MB device_put is 4× less
@@ -950,7 +1153,7 @@ def main():
         log(f"wire-bandwidth probe failed: {e!r}")
     if devs[0].platform == "tpu":  # peak constant is the v5e figure
         extra["mfu_end_to_end"] = round(
-            feat["value"] * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS, 5)
+            extra["value"] * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS, 5)
         if compute_ips:
             extra["mfu_compute"] = round(
                 compute_ips * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS, 5)
@@ -986,7 +1189,7 @@ def main():
         except Exception as e:  # baseline failure must not kill the bench
             log(f"baseline measurement failed: {e!r}")
 
-    extra["vs_baseline"] = (round(feat["value"] / base["value"], 3)
+    extra["vs_baseline"] = (round(extra["value"] / base["value"], 3)
                             if base else None)
     # canonical key order for the judged line
     out = {k: extra[k] for k in ("metric", "value", "unit", "vs_baseline")}
@@ -995,4 +1198,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--featurize-trial":
+        arm, trial_n, trial_batch, trial_dtype = sys.argv[2:6]
+        run_featurize_trial(arm, int(trial_n), int(trial_batch), trial_dtype)
+    else:
+        main()

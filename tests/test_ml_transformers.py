@@ -212,6 +212,27 @@ class TestNamedImage:
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
         assert got.shape == (4, 2048)
 
+    def test_warmup_no_fetch_then_transform_matches(self):
+        """``warmup`` compiles+executes WITHOUT any device→host read (the
+        streaming-mode-preserving warm path, BASELINE.md two-mode model)
+        and a subsequent transform reuses the warmed program and matches
+        the unwarmed transformer's output."""
+        from tpudl.ml import DeepImageFeaturizer
+
+        frame = _image_frame(n=4, h=36, w=36, seed=3)
+        warm = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                   modelName="ResNet50", batchSize=4)
+        ret = warm.warmup(36, 36)
+        assert ret is warm  # chainable
+        jfn_after_warm = warm._get_jfn()
+        got = np.stack(list(warm.transform(frame)["features"]))
+        # same cached program object — warmup did not fork a new jit
+        assert warm._get_jfn() is jfn_after_warm
+        cold = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                   modelName="ResNet50", batchSize=4)
+        want = np.stack(list(cold.transform(frame)["features"]))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
     def test_predictor_decode_topk(self):
         from tpudl.ml import DeepImagePredictor
 

@@ -60,6 +60,7 @@ def shard_sequence(tree, mesh, axis: str = M.DATA_AXIS):
 
 
 def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
+                   head_axis: str | None = None,
                    causal: bool = False, use_pallas: bool = False,
                    pallas_block: int = 128,
                    pallas_interpret: bool | None = None):
@@ -70,6 +71,12 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
     inputs are accepted and constrained). ``seq`` must divide evenly by
     the axis size. Returns [batch, seq, heads, head_dim] with the same
     sequence sharding.
+
+    ``head_axis`` additionally shards the HEADS dim over that mesh axis
+    — the tensor-parallel composition (SP ring × TP heads): heads are
+    embarrassingly parallel in attention, so the ring body runs
+    unchanged on its head shard and no extra collective is needed
+    inside; ``heads`` must divide by the axis size.
 
     Communication: n-1 neighbor ``ppermute`` hops of the local K/V block
     (each hop overlaps the block's score/accumulate compute in XLA's
@@ -90,11 +97,16 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
     if q.shape[1] % n:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by ring size {n}")
-    seq_spec = P(None, axis, None, None)
+    if head_axis is not None and q.shape[2] % mesh.shape[head_axis]:
+        raise ValueError(
+            f"heads {q.shape[2]} not divisible by mesh axis "
+            f"{head_axis!r} size {mesh.shape[head_axis]}")
+    vary_axes = (axis,) if head_axis is None else (axis, head_axis)
+    seq_spec = P(None, axis, head_axis, None)
     if use_pallas:
         return _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec,
                                       causal, pallas_block,
-                                      pallas_interpret)
+                                      pallas_interpret, vary_axes)
 
     def local(qb, kb, vb):
         # qb/kb/vb: [B, S/n, H, D] — this device's blocks
@@ -112,7 +124,7 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
         # the carry becomes device-varying after one step (it mixes in the
         # rotating K/V); mark the initial values varying so scan's carry
         # types line up under shard_map's varying-axis tracking
-        m, l, acc = (_mark_varying(t, axis) for t in (m, l, acc))
+        m, l, acc = (_mark_varying(t, vary_axes) for t in (m, l, acc))
 
         perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -150,7 +162,7 @@ def ring_attention(q, k, v, mesh, *, axis: str = M.DATA_AXIS,
 
 
 def _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec, causal,
-                           block, interpret):
+                           block, interpret, vary_axes=None):
     """Ring loop where each step is one Pallas flash-attention call over
     the local Q shard and the rotating K/V block; partials merge via
     log-sum-exp weights (exact — same math as the in-kernel online
@@ -170,7 +182,8 @@ def _ring_attention_pallas(q, k, v, mesh, axis, n, seq_spec, causal,
         o0 = jnp.zeros(qb.shape, jnp.float32)
         lse0 = jnp.full((qb.shape[0], s_loc, qb.shape[2]), _NEG_INF,
                         jnp.float32)
-        o0, lse0 = (_mark_varying(t, axis) for t in (o0, lse0))
+        o0, lse0 = (_mark_varying(t, vary_axes or (axis,))
+                    for t in (o0, lse0))
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def step(carry, s):
@@ -231,10 +244,12 @@ def _rotate_unless_last(kc, vc, s, n, axis, perm):
         (kc, vc))
 
 
-def _mark_varying(t, axis):
-    """Mark ``t`` device-varying over ``axis`` under shard_map's
-    varying-axis type tracking (``lax.pcast`` on current jax; ``pvary``
-    is the 0.6–0.7 spelling within the supported floor)."""
+def _mark_varying(t, axes):
+    """Mark ``t`` device-varying over ``axes`` (a name or tuple of
+    names) under shard_map's varying-axis type tracking (``lax.pcast``
+    on current jax; ``pvary`` is the 0.6–0.7 spelling within the
+    supported floor)."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(t, (axis,), to="varying")
-    return jax.lax.pvary(t, (axis,))  # pragma: no cover - jax 0.6/0.7
+        return jax.lax.pcast(t, axes, to="varying")
+    return jax.lax.pvary(t, axes)  # pragma: no cover - jax 0.6/0.7

@@ -120,7 +120,9 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
     def _head_fn(self, model, params):  # pragma: no cover - abstract
         raise NotImplementedError
 
-    def _apply_batches(self, frame, out_col):
+    def _get_jfn(self):
+        """The fused jitted program (cached per (model, weights, dtype)):
+        uint8 batch → float → resize(model geometry) → preprocess → net."""
         name = self.getModelName()
         dtype = self.computeDtype
 
@@ -158,7 +160,41 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
             key = (name, self.weights, dtype)
         else:  # file-backed weights may be rewritten between calls
             key = (name, self.weights, dtype, os.path.getmtime(self.weights))
-        jfn = self._cached_jit(key, build)
+        return self._cached_jit(key, build)
+
+    def warmup(self, height, width, nChannels=3, dtype=np.uint8):
+        """Compile and warm the fused program for (height, width,
+        nChannels) input images WITHOUT any device→host read.
+
+        On tunneled/remote PJRT backends the process's FIRST device→host
+        fetch permanently switches the channel from pipelined streaming
+        to per-transfer synchronization (BASELINE.md "two transfer
+        modes"; uploads drop from 300–1500 to 3–20 MB/s). Warming up by
+        running ``transform`` ends with exactly such a fetch. This
+        method instead executes the program once on a synthetic batch
+        and discards the device result unread — executions do not
+        trigger the mode switch — so a fresh process that calls
+        ``warmup(...)`` and then ``transform(frame)`` keeps every upload
+        pipelined until the transform's single final fetch.
+
+        Call with the shape of the frame's images (pre-resize: the
+        on-device pipeline resizes to the model geometry, so the traced
+        signature is the *input* shape). Returns ``self`` for chaining.
+        """
+        import jax
+
+        jfn = self._get_jfn()
+        x = np.zeros((self.batchSize, height, width, nChannels), dtype=dtype)
+        if self.mesh is not None:
+            from tpudl import mesh as M
+
+            x, _ = M.pad_batch(x, self.mesh.shape[M.DATA_AXIS])
+            x = M.shard_batch(x, self.mesh)
+        jax.block_until_ready(jfn(x))  # compile + execute; never fetched
+        return self
+
+    def _apply_batches(self, frame, out_col):
+        jfn = self._get_jfn()
         return frame.map_batches(
             jfn, [self.getInputCol()], [out_col],
             batch_size=self.batchSize, mesh=self.mesh,

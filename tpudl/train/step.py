@@ -19,7 +19,8 @@ from tpudl import mesh as M
 __all__ = ["make_train_step", "make_eval_step"]
 
 
-def make_train_step(loss_fn, optimizer, mesh=None, donate=True):
+def make_train_step(loss_fn, optimizer, mesh=None, donate=True,
+                    param_shardings=None):
     """Build ``step(params, opt_state, *batch) -> (params, opt_state,
     loss)``, jit-compiled as one SPMD program.
 
@@ -28,6 +29,13 @@ def make_train_step(loss_fn, optimizer, mesh=None, donate=True):
     already contracts over the data axis, the backward pass's reduction
     IS the allreduce — XLA emits the psum over ICI, replacing
     hvd.DistributedOptimizer's NCCL ring.
+
+    ``param_shardings`` (a pytree of NamedSharding matching ``params``)
+    overrides the default fully-replicated param constraint — the
+    tensor-parallel hook: pass the model's ``param_shardings(mesh)`` and
+    params, grads, and optimizer state all stay sharded over the
+    ``model`` axis through the whole step (grads inherit the param
+    sharding through AD; XLA keeps the update local to each shard).
     """
 
     def step(params, opt_state, *batch):
@@ -37,8 +45,11 @@ def make_train_step(loss_fn, optimizer, mesh=None, donate=True):
                     b, NamedSharding(mesh, P(M.DATA_AXIS,
                                              *([None] * (b.ndim - 1)))))
                 for b in batch)
-            params = jax.lax.with_sharding_constraint(
-                params, NamedSharding(mesh, P()))
+            params = (jax.lax.with_sharding_constraint(params,
+                                                       param_shardings)
+                      if param_shardings is not None else
+                      jax.lax.with_sharding_constraint(
+                          params, NamedSharding(mesh, P())))
         loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)

@@ -20,8 +20,6 @@ signature parity and ignored.
 
 from __future__ import annotations
 
-import numpy as np
-
 from tpudl.udf.registry import UDF, register_udf
 
 __all__ = ["makeGraphUDF"]
@@ -51,6 +49,13 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
     from tpudl.ingest.builder import GraphFunction
     from tpudl.ingest.input import TFInputGraph
 
+    if fetches is not None and isinstance(fetches, str):
+        # a bare string would be list()-split into characters below and
+        # surface as a baffling unknown-node error deep in the ingest
+        # layer; a single fetch is still a one-element list
+        raise TypeError(
+            f"fetches must be a sequence of tensor names, got the "
+            f"string {fetches!r} — wrap it: fetches=[{fetches!r}]")
     if isinstance(graph, TFInputGraph):
         fn = graph.make_fn(fetches=list(fetches) if fetches else None)
         if graph.trainable:
@@ -87,19 +92,11 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
     jfn = jax.jit(first_fetch)
 
     def frame_fn(frame):
+        # map_batches's default pack already stacks numeric and
+        # object-of-array columns (frame._default_pack)
         return frame.map_batches(
-            jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh,
-            pack=_pack_numeric)
+            jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh)
 
     if register:
         return register_udf(udf_name, frame_fn, in_cols[0], out_col)
     return UDF(str(udf_name), frame_fn, in_cols[0], out_col)
-
-
-def _pack_numeric(sl: np.ndarray) -> np.ndarray:
-    """Column slice → stacked numeric batch (object columns of per-row
-    arrays included — the array<double> columns the reference's
-    TFTransformer consumed)."""
-    if sl.dtype == object:
-        return np.stack([np.asarray(v) for v in sl])
-    return np.asarray(sl)

@@ -79,10 +79,63 @@ class TinyCausalLM:
             }
         return params
 
+    # -- tensor parallelism ------------------------------------------------
+    def param_shardings(self, mesh, model_axis: str = "model"):
+        """NamedSharding pytree for Megatron-style tensor parallelism
+        over ``mesh[model_axis]`` — the TPU-native spelling: shard the
+        PARAMS and let GSPMD partition the matmuls and insert the
+        all-reduces (scaling-book recipe; no hand-written collectives).
+
+        Layout per block: wq/wk/wv and w_up are COLUMN-parallel (output
+        dim sharded → each device computes its own heads / hidden
+        slice), wo and w_down are ROW-parallel (input dim sharded → XLA
+        emits one psum over ``model_axis`` after each, the two
+        all-reduces per layer of the Megatron pattern). Embedding,
+        norms, and row-parallel biases stay replicated.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tp = mesh.shape[model_axis]
+        if self.heads % tp or (4 * self.dim) % tp:
+            raise ValueError(
+                f"heads {self.heads} and mlp hidden {4 * self.dim} must "
+                f"divide the {model_axis!r} axis size {tp}")
+        col = NamedSharding(mesh, P(None, model_axis))   # output sharded
+        row = NamedSharding(mesh, P(model_axis, None))   # input sharded
+        rep = NamedSharding(mesh, P())
+        shardings: dict = {
+            "embed": {"table": rep},
+            "final_norm": {"gamma": rep, "beta": rep},
+        }
+        for i in range(self.layers):
+            shardings[f"block_{i}"] = {
+                "norm1_gamma": rep, "norm1_beta": rep,
+                "wq": col, "wk": col, "wv": col, "wo": row,
+                "norm2_gamma": rep, "norm2_beta": rep,
+                "w_up": col, "b_up": NamedSharding(mesh, P(model_axis)),
+                "w_down": row, "b_down": rep,
+            }
+        return shardings
+
+    def shard_params(self, params, mesh, model_axis: str = "model"):
+        """device_put ``params`` with :meth:`param_shardings` — each
+        device holds 1/tp of every column/row-parallel matrix."""
+        import jax
+
+        return jax.tree.map(jax.device_put, params,
+                            self.param_shardings(mesh, model_axis))
+
     # -- forward ----------------------------------------------------------
     def apply(self, params, tokens, *, mesh=None, use_pallas: bool = False,
-              remat: bool = False):
+              remat: bool = False, tp: bool = False):
         """tokens [B, S] int32 → logits [B, S, vocab].
+
+        ``tp=True`` (requires ``mesh`` with a >1 ``model`` axis) adds
+        tensor-parallel sharding constraints: attention heads and the
+        MLP hidden dim live sharded over the ``model`` axis (matching
+        :meth:`param_shardings`), composing with the ring path — the
+        full DP(batch, data axis) × SP(ring, data axis) × TP(heads/mlp,
+        model axis) program in one jit.
 
         ``remat=True`` wraps each decoder block in ``jax.checkpoint``:
         the backward pass recomputes block activations instead of
@@ -99,6 +152,27 @@ class TinyCausalLM:
         if s > self.max_len:
             raise ValueError(
                 f"sequence length {s} exceeds max_len {self.max_len}")
+        if tp and (mesh is None or "model" not in mesh.shape):
+            raise ValueError(
+                "tp=True needs a mesh with a 'model' axis "
+                "(tpudl.mesh.build_mesh(n_data=..., n_model=...))")
+        head_axis = "model" if tp and mesh.shape["model"] > 1 else None
+
+        def tp_constrain(t, spec):
+            # Pin ONLY the model-axis dim; every None becomes
+            # UNCONSTRAINED so GSPMD keeps whatever batch/seq sharding
+            # the surrounding program chose (a None here would mean
+            # "replicated" and force per-layer all-gathers of the
+            # DP-sharded activations over the data axis — verified in
+            # HLO during review).
+            if head_axis is None:
+                return t
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = tuple(P.UNCONSTRAINED if s is None else s for s in spec)
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(mesh, P(*spec)))
+
         x = params["embed"]["table"][tokens]              # [B, S, D]
 
         # rotary-free: learned-position-less (relative order comes from
@@ -112,9 +186,11 @@ class TinyCausalLM:
             def split(t):
                 return t.reshape(b, s, self.heads, self.dim // self.heads)
 
-            q, k, v = split(q), split(k), split(v)
+            q, k, v = (tp_constrain(split(t), (None, None, head_axis, None))
+                       for t in (q, k, v))
             if mesh is not None:
                 att = ring_attention(q, k, v, mesh, causal=True,
+                                     head_axis=head_axis,
                                      use_pallas=use_pallas)
             elif use_pallas:
                 from tpudl.pallas_ops import flash_attention
@@ -127,7 +203,10 @@ class TinyCausalLM:
             x = x + att.reshape(b, s, self.dim) @ p["wo"]
             h = _layer_norm(x, {"gamma": p["norm2_gamma"],
                                 "beta": p["norm2_beta"]})
-            h = jax.nn.gelu(h @ p["w_up"] + p["b_up"])
+            # hidden dim sharded over 'model' (column-parallel w_up);
+            # the following row-parallel w_down matmul ends in the psum
+            h = tp_constrain(jax.nn.gelu(h @ p["w_up"] + p["b_up"]),
+                             (None, None, head_axis))
             return x + h @ p["w_down"] + p["b_down"]
 
         if remat:
@@ -139,15 +218,18 @@ class TinyCausalLM:
 
     # -- training loss -----------------------------------------------------
     def loss_fn(self, *, mesh=None, use_pallas: bool = False,
-                remat: bool = False):
+                remat: bool = False, tp: bool = False):
         """``loss(params, tokens)``: next-token cross-entropy, mean over
         the global batch (the allreduce contraction —
         tpudl.train.make_train_step turns it into the ICI psum).
-        ``remat=True`` checkpoints each block (see :meth:`apply`)."""
+        ``remat=True`` checkpoints each block (see :meth:`apply`);
+        ``tp=True`` shards heads/MLP over the mesh's ``model`` axis
+        (pair with :meth:`shard_params` and
+        ``make_train_step(param_shardings=...)``)."""
 
         def loss(params, tokens):
             logits = self.apply(params, tokens[:, :-1], mesh=mesh,
-                                use_pallas=use_pallas, remat=remat)
+                                use_pallas=use_pallas, remat=remat, tp=tp)
             targets = tokens[:, 1:]
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             picked = jnp.take_along_axis(
