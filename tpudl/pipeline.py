@@ -1,0 +1,102 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 records it
+absent upstream); this is a beyond-reference addition completing the
+parallelism matrix (DP/SP/TP/EP/PP) on the same ``tpudl.mesh``
+abstraction. TPU-native shape: the schedule is a ``lax.scan`` whose body
+computes one pipeline tick on every stage simultaneously and rotates
+activations one hop along the axis with ``lax.ppermute`` (neighbor ICI
+traffic, same collective the ring-attention path rides); stage weights
+are the SHARDED leading dim of a stacked param pytree and never move.
+
+The classic GPipe schedule: with ``n`` stages and ``m`` microbatches,
+``m + n - 1`` ticks; stage ``s`` works on microbatch ``t - s`` at tick
+``t`` (the bubble is the usual ``(n-1)/(m+n-1)`` idle fraction).
+Backprop through the scan + ppermute IS the reverse pipeline — no
+separate backward schedule needed under ``jax.grad``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_blocks"]
+
+
+def pipeline_blocks(block_fn, stacked_params, x_micro, mesh, *,
+                    axis: str, data_axis: str | None = None):
+    """Run ``block_fn`` sequentially over the stacked blocks, pipelined
+    over ``mesh[axis]``.
+
+    - ``block_fn(x, p) -> y``: one block, shape-preserving (``y`` like
+      ``x``) — the composition law a pipeline needs.
+    - ``stacked_params``: pytree whose leaves have a leading BLOCK dim
+      (``L`` total blocks); sharded over ``axis`` so each of the ``n``
+      stages owns ``L/n`` consecutive blocks. ``L % n == 0``.
+    - ``x_micro``: ``[m, mb, ...]`` microbatched activations (``m``
+      microbatches). With ``data_axis``, the ``mb`` dim is additionally
+      sharded over it — DP×PP in one program.
+
+    Returns ``[m, mb, ...]`` outputs (the full sequential composition),
+    replicated over ``axis``.
+    """
+    n = mesh.shape[axis]
+    leaves = jax.tree.leaves(stacked_params)
+    n_blocks = leaves[0].shape[0]
+    if any(leaf.shape[0] != n_blocks for leaf in leaves):
+        raise ValueError("stacked_params leaves disagree on block count")
+    if n_blocks % n:
+        raise ValueError(
+            f"{n_blocks} blocks not divisible by {n} pipeline stages")
+    m = x_micro.shape[0]
+
+    param_specs = jax.tree.map(
+        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), stacked_params)
+    x_spec = P(None, data_axis, *([None] * (x_micro.ndim - 2)))
+
+    def local(p_local, xs):
+        # p_local: this stage's L/n blocks; xs: [m, mb_local, ...]
+        stage = lax.axis_index(axis)
+
+        def stage_apply(x):
+            def body(h, p):
+                return block_fn(h, p), None
+
+            h, _ = lax.scan(body, x, p_local)
+            return h
+
+        buf0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        out0 = jnp.zeros_like(xs)
+        perm = [(i, i + 1) for i in range(n - 1)]
+
+        def tick(carry, t):
+            buf, out = carry
+            mb = t - stage  # the microbatch this stage holds at tick t
+            x_in = jnp.where(stage == 0,
+                             xs[jnp.clip(t, 0, m - 1)], buf)
+            y = stage_apply(x_in)
+            # last stage banks its result while a live microbatch is in
+            written = lax.dynamic_update_index_in_dim(
+                out, y, jnp.clip(mb, 0, m - 1), 0)
+            live = (mb >= 0) & (mb < m) & (stage == n - 1)
+            out = jnp.where(live, written, out)
+            # one hop forward; the wrap-around edge is omitted (nothing
+            # consumes stage n-1's hand-off) so the collective is a pure
+            # neighbor shift
+            buf = (lax.ppermute(y, axis, perm) if n > 1 else y)
+            return (buf, out), None
+
+        (_, out), _ = lax.scan(tick, (buf0, out0), jnp.arange(m + n - 1))
+        # only stage n-1's buffer holds real outputs; psum broadcasts it
+        # (every other stage contributes zeros)
+        return lax.psum(jnp.where(stage == n - 1, out, 0.0), axis)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(param_specs, x_spec),
+                   out_specs=P(None, data_axis,
+                               *([None] * (x_micro.ndim - 2))),
+                   check_vma=False)
+    return fn(stacked_params, x_micro)

@@ -44,7 +44,8 @@ class TinyCausalLM:
     """
 
     def __init__(self, vocab: int = 256, dim: int = 64, heads: int = 4,
-                 layers: int = 2, max_len: int = 4096):
+                 layers: int = 2, max_len: int = 4096, experts: int = 0,
+                 capacity_factor: float = 2.0):
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
         self.vocab = vocab
@@ -52,6 +53,13 @@ class TinyCausalLM:
         self.heads = heads
         self.layers = layers
         self.max_len = max_len
+        # experts > 0 swaps each block's dense MLP for a top-1-routed
+        # mixture of experts (switch-style): the EXPERT dim is the
+        # tensor/expert-parallel dim — param_shardings lays experts out
+        # over the mesh's 'model' axis, and GSPMD inserts the
+        # dispatch/combine collectives (the GShard pattern)
+        self.experts = experts
+        self.capacity_factor = capacity_factor
 
     # -- params -----------------------------------------------------------
     def init(self, seed: int = 0) -> dict:
@@ -68,15 +76,29 @@ class TinyCausalLM:
                            "beta": np.zeros(d, np.float32)},
         }
         for i in range(self.layers):
-            params[f"block_{i}"] = {
+            block = {
                 "norm1_gamma": np.ones(d, np.float32),
                 "norm1_beta": np.zeros(d, np.float32),
                 "wq": w(d, d), "wk": w(d, d), "wv": w(d, d), "wo": w(d, d),
                 "norm2_gamma": np.ones(d, np.float32),
                 "norm2_beta": np.zeros(d, np.float32),
-                "w_up": w(d, 4 * d), "b_up": np.zeros(4 * d, np.float32),
-                "w_down": w(4 * d, d), "b_down": np.zeros(d, np.float32),
             }
+            if self.experts:
+                e = self.experts
+                block.update({
+                    "w_gate": w(d, e, scale=0.02),
+                    "w_up_e": np.stack([w(d, 4 * d) for _ in range(e)]),
+                    "b_up_e": np.zeros((e, 4 * d), np.float32),
+                    "w_down_e": np.stack([w(4 * d, d) for _ in range(e)]),
+                    "b_down_e": np.zeros((e, d), np.float32),
+                })
+            else:
+                block.update({
+                    "w_up": w(d, 4 * d), "b_up": np.zeros(4 * d, np.float32),
+                    "w_down": w(4 * d, d),
+                    "b_down": np.zeros(d, np.float32),
+                })
+            params[f"block_{i}"] = block
         return params
 
     # -- tensor parallelism ------------------------------------------------
@@ -100,21 +122,42 @@ class TinyCausalLM:
             raise ValueError(
                 f"heads {self.heads} and mlp hidden {4 * self.dim} must "
                 f"divide the {model_axis!r} axis size {tp}")
+        if self.experts and self.experts % tp:
+            raise ValueError(
+                f"experts {self.experts} must divide the {model_axis!r} "
+                f"axis size {tp}")
         col = NamedSharding(mesh, P(None, model_axis))   # output sharded
         row = NamedSharding(mesh, P(model_axis, None))   # input sharded
         rep = NamedSharding(mesh, P())
+        bias_col = NamedSharding(mesh, P(model_axis))    # column bias
         shardings: dict = {
             "embed": {"table": rep},
             "final_norm": {"gamma": rep, "beta": rep},
         }
         for i in range(self.layers):
-            shardings[f"block_{i}"] = {
+            block = {
                 "norm1_gamma": rep, "norm1_beta": rep,
                 "wq": col, "wk": col, "wv": col, "wo": row,
                 "norm2_gamma": rep, "norm2_beta": rep,
-                "w_up": col, "b_up": NamedSharding(mesh, P(model_axis)),
-                "w_down": row, "b_down": rep,
             }
+            if self.experts:
+                # expert parallelism: the EXPERT (leading) dim is the
+                # sharded dim — each device owns E/tp whole experts
+                # (their FFN weights never move; tokens do, via the
+                # dispatch einsum's collectives)
+                block.update({
+                    "w_gate": rep,
+                    "w_up_e": NamedSharding(mesh, P(model_axis, None, None)),
+                    "b_up_e": NamedSharding(mesh, P(model_axis, None)),
+                    "w_down_e": NamedSharding(mesh, P(model_axis, None, None)),
+                    "b_down_e": NamedSharding(mesh, P(model_axis, None)),
+                })
+            else:
+                block.update({
+                    "w_up": col, "b_up": bias_col,
+                    "w_down": row, "b_down": rep,
+                })
+            shardings[f"block_{i}"] = block
         return shardings
 
     def shard_params(self, params, mesh, model_axis: str = "model"):
@@ -178,36 +221,22 @@ class TinyCausalLM:
         # rotary-free: learned-position-less (relative order comes from
         # the causal mask; adequate for the convergence tests this
         # model exists for, and keeps the ring path position-agnostic)
-        def block(x, p):
-            h = _layer_norm(x, {"gamma": p["norm1_gamma"],
-                                "beta": p["norm1_beta"]})
-            q, k, v = (h @ p[w] for w in ("wq", "wk", "wv"))
-
-            def split(t):
-                return t.reshape(b, s, self.heads, self.dim // self.heads)
-
-            q, k, v = (tp_constrain(split(t), (None, None, head_axis, None))
-                       for t in (q, k, v))
+        def attn(q, k, v):
             if mesh is not None:
-                att = ring_attention(q, k, v, mesh, causal=True,
-                                     head_axis=head_axis,
-                                     use_pallas=use_pallas)
-            elif use_pallas:
+                return ring_attention(q, k, v, mesh, causal=True,
+                                      head_axis=head_axis,
+                                      use_pallas=use_pallas)
+            if use_pallas:
                 from tpudl.pallas_ops import flash_attention
 
-                att = flash_attention(
+                return flash_attention(
                     q, k, v, causal=True,
                     interpret=jax.default_backend() != "tpu")
-            else:
-                att = attention_reference(q, k, v, causal=True)
-            x = x + att.reshape(b, s, self.dim) @ p["wo"]
-            h = _layer_norm(x, {"gamma": p["norm2_gamma"],
-                                "beta": p["norm2_beta"]})
-            # hidden dim sharded over 'model' (column-parallel w_up);
-            # the following row-parallel w_down matmul ends in the psum
-            h = tp_constrain(jax.nn.gelu(h @ p["w_up"] + p["b_up"]),
-                             (None, None, head_axis))
-            return x + h @ p["w_down"] + p["b_down"]
+            return attention_reference(q, k, v, causal=True)
+
+        def block(x, p):
+            return self._decoder_block(x, p, attn, tp_constrain,
+                                       head_axis)
 
         if remat:
             block = jax.checkpoint(block)
@@ -215,6 +244,121 @@ class TinyCausalLM:
             x = block(x, params[f"block_{i}"])
         x = _layer_norm(x, params["final_norm"])
         return x @ params["embed"]["table"].T              # tied head
+
+    def apply_pipelined(self, params, tokens, mesh, *,
+                        pipe_axis: str = "model", n_micro: int = 2,
+                        data_axis: str | None = None):
+        """Forward pass with the decoder blocks PIPELINED over
+        ``mesh[pipe_axis]`` (GPipe microbatch schedule,
+        :func:`tpudl.pipeline.pipeline_blocks`): stage ``i`` owns blocks
+        ``[i·L/n, (i+1)·L/n)`` — weights stay put, activations hop
+        stage-to-stage on neighbor ``ppermute``. Embed and head run
+        replicated outside the pipe. ``data_axis`` additionally shards
+        the microbatch dim over it — DP×PP in one jitted program.
+
+        Attention inside the pipe is dense (each microbatch is whole on
+        its stage); the ring/SP path is the ``apply(mesh=...)``
+        spelling. ``batch % n_micro == 0``; MoE blocks unsupported here.
+        """
+        from tpudl.pipeline import pipeline_blocks
+
+        if self.experts:
+            raise NotImplementedError(
+                "pipelined MoE blocks not supported; use apply(tp=True) "
+                "for expert parallelism")
+        b, s = tokens.shape
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by {n_micro} "
+                             "microbatches")
+        from tpudl.attention import attention_reference
+
+        def block(x, p):
+            return self._decoder_block(
+                x, p, lambda q, k, v: attention_reference(q, k, v,
+                                                          causal=True))
+
+        x = params["embed"]["table"][tokens]              # [B, S, D]
+        xm = x.reshape(n_micro, b // n_micro, s, self.dim)
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[params[f"block_{i}"] for i in range(self.layers)])
+        ym = pipeline_blocks(block, stacked, xm, mesh, axis=pipe_axis,
+                             data_axis=data_axis)
+        x = ym.reshape(b, s, self.dim)
+        x = _layer_norm(x, params["final_norm"])
+        return x @ params["embed"]["table"].T              # tied head
+
+    def _decoder_block(self, x, p, attn, constrain=lambda t, spec: t,
+                       head_axis=None):
+        """ONE pre-norm decoder block — the single definition of the
+        block math, shared by :meth:`apply` (dense/ring/pallas via
+        ``attn``) and :meth:`apply_pipelined` (dense ``attn``), so the
+        two paths can never silently diverge. ``constrain`` is the
+        tensor-parallel sharding hook (identity when TP is off)."""
+        b, s = x.shape[0], x.shape[1]
+        h = _layer_norm(x, {"gamma": p["norm1_gamma"],
+                            "beta": p["norm1_beta"]})
+        q, k, v = (h @ p[w] for w in ("wq", "wk", "wv"))
+
+        def split(t):
+            return t.reshape(b, s, self.heads, self.dim // self.heads)
+
+        q, k, v = (constrain(split(t), (None, None, head_axis, None))
+                   for t in (q, k, v))
+        att = attn(q, k, v)
+        x = x + att.reshape(b, s, self.dim) @ p["wo"]
+        h = _layer_norm(x, {"gamma": p["norm2_gamma"],
+                            "beta": p["norm2_beta"]})
+        if self.experts:
+            return x + self._moe_ffn(h, p, constrain, head_axis)
+        # hidden dim sharded over 'model' (column-parallel w_up); the
+        # following row-parallel w_down matmul ends in the psum
+        h = constrain(jax.nn.gelu(h @ p["w_up"] + p["b_up"]),
+                      (None, None, head_axis))
+        return x + h @ p["w_down"] + p["b_down"]
+
+    def _moe_ffn(self, h, p, tp_constrain, head_axis):
+        """Top-1-routed (switch-style) mixture-of-experts FFN — the
+        expert-parallel layer. Per token: softmax gate picks ONE expert;
+        tokens are packed into per-expert capacity buffers by a one-hot
+        dispatch einsum (the GShard pattern), each expert's FFN runs on
+        its buffer, and a combine einsum scatters results back weighted
+        by the gate probability. Tokens over an expert's capacity
+        contribute nothing — the residual passes them through unchanged
+        (switch semantics).
+
+        Parallelism: with experts sharded over ``model``
+        (:meth:`param_shardings`) and the batch over ``data``, the
+        dispatch/combine einsums are exactly where GSPMD inserts the
+        EP collectives — tokens travel to their expert's device, FFN
+        weights never move.
+        """
+        b, s, d = h.shape
+        e = self.experts
+        cap = max(1, int(math.ceil(s * self.capacity_factor / e)))
+        logits = h @ p["w_gate"]                              # [B,S,E]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        gate = probs.max(-1)                                  # [B,S]
+        choice = probs.argmax(-1)                             # [B,S]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [B,S,E]
+        # position of each token within its expert's buffer (per row)
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0        # [B,S,E]
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                              dtype=jnp.float32)               # [B,S,E,C]
+        keep = ((pos >= 0) & (pos < cap)).astype(jnp.float32)  # [B,S,E]
+        dispatch = slot * keep[..., None]                      # [B,S,E,C]
+        combine = dispatch * gate[..., None, None]
+        xe = jnp.einsum("bsec,bsd->ebcd", dispatch,
+                        h.astype(jnp.float32))                 # [E,B,C,D]
+        xe = tp_constrain(xe, (head_axis, None, None, None))
+        u = jax.nn.gelu(jnp.einsum("ebcd,edh->ebch", xe,
+                                   p["w_up_e"].astype(jnp.float32))
+                        + p["b_up_e"][:, None, None, :])
+        ye = (jnp.einsum("ebch,ehd->ebcd", u,
+                         p["w_down_e"].astype(jnp.float32))
+              + p["b_down_e"][:, None, None, :])
+        ye = tp_constrain(ye, (head_axis, None, None, None))
+        return jnp.einsum("bsec,ebcd->bsd", combine, ye).astype(h.dtype)
 
     # -- training loss -----------------------------------------------------
     def loss_fn(self, *, mesh=None, use_pallas: bool = False,
