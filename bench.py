@@ -231,20 +231,37 @@ def _update_streaming_summary(out, arms, extra):
     pairs = out["interleaved_pairs"]
     out["trials"] = [round(r, 1) for r in arms["prefetch"]]
     out["serial_trials"] = [round(r, 1) for r in arms["serial"]]
-    # the headline is the prefetch arm; if that arm produced nothing the
-    # serial median stands in and the record SAYS so — a silent
-    # substitution would misattribute serial rates to the prefetch path
+    # Headline = median over ALL streaming trials (both arms): in
+    # streaming mode the transform is wire-DELIVERY-bound, so the two
+    # arms are the same operating point and the per-trial spread is
+    # link weather — an arm-restricted median would just sample fewer
+    # weather draws (observed: arm medians 70 vs 108 img/s from the
+    # same night's weather; both arms' wire-normalized medians agree).
+    # The sync-mode record below is where prefetch-vs-serial is a real
+    # A/B (pack/transfer overlap matters when each batch round-trips).
+    both = arms["prefetch"] + arms["serial"]
+    out["value"] = round(statistics.median(both), 2)
+    if arms["prefetch"] and arms["serial"]:
+        out["headline_arm"] = "combined"
+    else:  # one arm produced nothing — the record SAYS so rather than
+        out["headline_arm"] = ("prefetch_only" if arms["prefetch"]
+                               else "serial_only")  # silently standing in
     if arms["prefetch"]:
-        out["value"] = round(statistics.median(arms["prefetch"]), 2)
-        out["headline_arm"] = "prefetch"
-    elif arms["serial"]:
-        out["value"] = round(statistics.median(arms["serial"]), 2)
-        out["headline_arm"] = "serial_fallback"
+        out["prefetch_median"] = round(
+            statistics.median(arms["prefetch"]), 2)
     if arms["serial"]:
         out["serial_median"] = round(statistics.median(arms["serial"]), 2)
     # rate ÷ contemporaneous SYNC-mode wire ceiling: values > 1 are the
     # pipelining win made visible (streaming mode beats what the
-    # synchronized wire could ever carry)
+    # synchronized wire could ever carry); per-arm medians let the
+    # weather-free arm comparison be read off the record
+    for arm in ("prefetch", "serial"):
+        over = [p["images_per_sec"] / p["sync_wire_bound_images_per_sec"]
+                for p in pairs
+                if p["arm"] == arm and p.get("sync_wire_bound_images_per_sec")]
+        if over:
+            out[f"{arm}_over_sync_ceiling_median"] = round(
+                statistics.median(over), 2)
     over = [p["images_per_sec"] / p["sync_wire_bound_images_per_sec"]
             for p in pairs if p.get("sync_wire_bound_images_per_sec")]
     if over:
@@ -252,10 +269,10 @@ def _update_streaming_summary(out, arms, extra):
             statistics.median(over), 2)
     if extra is not None and "value" in out:
         extra["value"] = out["value"]
-        extra["headline_mode"] = ("streaming_fresh_process"
-                                  if out["headline_arm"] == "prefetch"
-                                  else "streaming_fresh_process_serial_"
-                                       "fallback")
+        extra["headline_mode"] = (
+            "streaming_fresh_process"
+            if out["headline_arm"] == "combined"
+            else f"streaming_fresh_process_{out['headline_arm']}")
 
 
 def measure_featurize(n, batch, dtype, trials=5):

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""The full parallelism matrix on one mesh: DP × SP × TP × EP × PP.
+
+The reference's capability surface is data-parallel only (SURVEY.md
+§2.4); tpudl adds the rest TPU-natively on the same ``tpudl.mesh``
+abstraction — shardings + GSPMD for TP/EP, shard_map ring for SP, a
+scan/ppermute GPipe schedule for PP. This example trains/runs a small
+causal LM under each composition and checks them against the plain
+single-device run.
+
+Run on any device count (uses an 8-way virtual CPU mesh if needed):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/parallelism_matrix.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpudl import mesh as M
+from tpudl.train import make_train_step
+from tpudl.zoo.transformer import TinyCausalLM
+
+
+def main():
+    if jax.device_count() < 4:
+        print(f"only {jax.device_count()} device(s); this example needs >=4 "
+              "(see the XLA_FLAGS line in the docstring)")
+        return
+    mesh = M.build_mesh(n_data=jax.device_count() // 2, n_model=2)
+    print(f"mesh: {dict(mesh.shape)}")
+    toks = np.random.default_rng(0).integers(0, 32, (8, 33), np.int32)
+
+    # -- DP x SP(ring) x TP(Megatron) ------------------------------------
+    lm = TinyCausalLM(vocab=32, dim=32, heads=4, layers=2)
+    params = lm.init(0)
+    ref = float(lm.loss_fn()(params, jnp.asarray(toks)))
+    step = make_train_step(lm.loss_fn(mesh=mesh, tp=True), optax.sgd(0.05),
+                           mesh=mesh,
+                           param_shardings=lm.param_shardings(mesh))
+    with M.use_mesh(mesh):
+        p = lm.shard_params(params, mesh)       # wq holds D/2 columns/device
+        p, _, loss = step(p, optax.sgd(0.05).init(p),
+                          M.shard_batch(toks, mesh))
+    print(f"DPxSPxTP train step: loss {float(loss):.4f} "
+          f"(single-device {ref:.4f})")
+
+    # -- EP: mixture of experts, experts sharded over 'model' -------------
+    moe = TinyCausalLM(vocab=32, dim=32, heads=4, layers=2, experts=4,
+                       capacity_factor=2.0)
+    mp = moe.init(0)
+    ref_moe = float(moe.loss_fn()(mp, jnp.asarray(toks)))
+    estep = make_train_step(moe.loss_fn(mesh=mesh, tp=True),
+                            optax.sgd(0.05), mesh=mesh,
+                            param_shardings=moe.param_shardings(mesh))
+    with M.use_mesh(mesh):
+        ep = moe.shard_params(mp, mesh)         # 2 whole experts/device
+        ep, _, eloss = estep(ep, optax.sgd(0.05).init(ep),
+                             M.shard_batch(toks, mesh))
+    print(f"EP(MoE) train step:  loss {float(eloss):.4f} "
+          f"(single-device {ref_moe:.4f})")
+
+    # -- PP: GPipe over the block stack, DP microbatches ------------------
+    logits_seq = lm.apply(params, jnp.asarray(toks[:, :-1]))
+    logits_pp = jax.jit(lambda p, t: lm.apply_pipelined(
+        p, t, mesh, n_micro=2, data_axis=M.DATA_AXIS))(
+            params, jnp.asarray(toks[:, :-1]))
+    err = float(jnp.max(jnp.abs(logits_pp - logits_seq)))
+    print(f"DPxPP forward:       max|Δlogits| vs sequential = {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

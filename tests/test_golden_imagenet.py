@@ -20,11 +20,13 @@ import os
 import numpy as np
 import pytest
 
+from tpudl.zoo.registry import SUPPORTED_MODELS
+
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
 GEN_HINT = ("generate with tools/make_imagenet_goldens.py on a networked "
             "host, commit tests/goldens/, set TPUDL_WEIGHTS_DIR")
 
-_MODELS = ["InceptionV3", "Xception", "ResNet50", "VGG16", "VGG19"]
+_MODELS = sorted(SUPPORTED_MODELS)  # every registry entry stays armed
 
 
 def _golden_path(name):
@@ -111,7 +113,7 @@ def test_harness_self_check(tmp_path, monkeypatch, name):
     x = rng.integers(0, 256, size=(2, h, w, 3), dtype=np.uint8)
     # cut layer + preprocess module come from the registry — the SAME
     # definitions the generator uses, so they can never drift apart
-    feat_km = keras.Model(km.input, km.get_layer(model.feature_cut).output)
+    feat_km = model.feature_cut_model(km)
     mod = getattr(keras.applications, model.keras_module)
     expected = feat_km.predict(
         mod.preprocess_input(x.astype(np.float32)),

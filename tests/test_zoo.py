@@ -23,7 +23,7 @@ keras = pytest.importorskip("keras")
 
 # smallest legal input per architecture (keeps the CPU oracle fast)
 _SMALL = {"InceptionV3": 75, "Xception": 71, "ResNet50": 32, "VGG16": 32,
-          "VGG19": 32}
+          "VGG19": 32, "MobileNetV2": 32, "DenseNet121": 32}
 
 
 @pytest.fixture(scope="module")
@@ -46,6 +46,20 @@ def test_features_match_keras(name, rng):
     ref = km.predict(x, verbose=0)
     ours = np.asarray(m.apply(params, jnp.asarray(x), include_top=False))
     assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_mobilenetv2_featurize_is_pooled_1280(rng):
+    """MobileNetV2 featurize == keras no-top pooling='avg' (the
+    1280-d out_relu global average — the DeepImageFeaturizer vector)."""
+    m = getKerasApplicationModel("MobileNetV2")
+    km = m.keras_builder()(weights=None, include_top=False,
+                           pooling="avg", input_shape=(64, 64, 3))
+    params = params_from_keras(km)
+    x = _rand(rng, 64)
+    ref = km.predict(x, verbose=0)
+    ours = np.asarray(m.featurize(params, jnp.asarray(x)))
+    assert ours.shape == (2, 1280)
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
 
 

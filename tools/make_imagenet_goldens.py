@@ -75,8 +75,7 @@ def main():
         rng = np.random.default_rng(GOLDEN_SEED)
         x = rng.integers(0, 256, size=(GOLDEN_BATCH, h, w, 3),
                          dtype=np.uint8)
-        feat_km = keras.Model(km.input,
-                              km.get_layer(model.feature_cut).output)
+        feat_km = model.feature_cut_model(km)
         mod = getattr(keras.applications, model.keras_module)
         feats = feat_km.predict(mod.preprocess_input(x.astype(np.float32)),
                                 verbose=0).astype(np.float32)

@@ -148,6 +148,27 @@ class Store:
         return nn.separable_conv2d(x, p["depthwise_kernel"], p["pointwise_kernel"],
                                    p.get("bias"), strides=strides, padding=padding)
 
+    def depthwise_conv(self, x, kernel_size, *, strides=(1, 1),
+                       padding="SAME", use_bias=True, name=None):
+        """Keras DepthwiseConv2D (depth multiplier 1): param key
+        ``depthwise_kernel`` (kh, kw, cin, 1), matching the Keras weight
+        layout so conversion stays mechanical."""
+        kh, kw = ((kernel_size, kernel_size)
+                  if isinstance(kernel_size, int) else kernel_size)
+        lname = self.name("depthwise_conv2d", name)
+        cin = x.shape[-1]
+
+        def make():
+            p = {"depthwise_kernel": glorot_uniform(
+                self._next_rng(), (kh, kw, cin, 1), self.param_dtype)}
+            if use_bias:
+                p["bias"] = self._zeros((cin,))
+            return p
+
+        p = self._get(lname, make)
+        return nn.depthwise_conv2d(x, p["depthwise_kernel"], p.get("bias"),
+                                   strides=strides, padding=padding)
+
     def bn(self, x, *, scale=True, epsilon=1e-3, momentum=0.99, name=None):
         lname = self.name("batch_normalization", name)
         c = x.shape[-1]

@@ -1,0 +1,74 @@
+"""DenseNet121 as a pure JAX build function.
+
+Beyond-reference zoo breadth (the reference registry stops at 5
+architectures — sparkdl transformers/keras_applications.py ~L60-200).
+Structure and layer names mirror keras.applications.densenet exactly
+(dense blocks of BN→relu→1×1→BN→relu→3×3 conv-blocks concatenated on
+channels; 0.5-compression transition blocks; BN epsilon 1.001e-5;
+'torch' preprocessing), so pretrained-weight conversion stays mechanical
+name-mapping.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpudl.zoo import nn
+from tpudl.zoo.core import Store
+
+NAME = "DenseNet121"
+INPUT_SIZE = (224, 224)
+FEATURE_DIM = 1024
+PREPROCESS_MODE = "torch"
+
+_BLOCKS = (6, 12, 24, 16)  # DenseNet121
+_GROWTH = 32
+
+
+def _conv_block(s: Store, x, name):
+    x1 = s.bn(x, epsilon=1.001e-5, name=f"{name}_0_bn")
+    x1 = nn.relu(x1)
+    x1 = s.conv(x1, 4 * _GROWTH, 1, use_bias=False, name=f"{name}_1_conv")
+    x1 = s.bn(x1, epsilon=1.001e-5, name=f"{name}_1_bn")
+    x1 = nn.relu(x1)
+    x1 = s.conv(x1, _GROWTH, 3, padding="SAME", use_bias=False,
+                name=f"{name}_2_conv")
+    return jnp.concatenate([x, x1], axis=-1)
+
+
+def _transition_block(s: Store, x, name):
+    x = s.bn(x, epsilon=1.001e-5, name=f"{name}_bn")
+    x = nn.relu(x)
+    x = s.conv(x, int(x.shape[-1] * 0.5), 1, use_bias=False,
+               name=f"{name}_conv")
+    return nn.avg_pool(x, (2, 2), strides=(2, 2), padding="VALID")
+
+
+def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    x = nn.zero_pad(x, ((3, 3), (3, 3)))
+    x = s.conv(x, 64, 7, strides=(2, 2), padding="VALID", use_bias=False,
+               name="conv1_conv")
+    x = s.bn(x, epsilon=1.001e-5, name="conv1_bn")
+    x = nn.relu(x)
+    x = nn.zero_pad(x, ((1, 1), (1, 1)))
+    x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+    for i, blocks in enumerate(_BLOCKS):
+        dense_name = f"conv{i + 2}"
+        for b in range(blocks):
+            x = _conv_block(s, x, name=f"{dense_name}_block{b + 1}")
+        if i < len(_BLOCKS) - 1:
+            x = _transition_block(s, x, name=f"pool{i + 2}")
+
+    x = s.bn(x, epsilon=1.001e-5, name="bn")
+    x = nn.relu(x)
+
+    if include_top:
+        x = nn.global_avg_pool(x)
+        x = s.dense(x, classes, name="predictions")
+        return nn.softmax(x)
+    if pooling == "avg":
+        return nn.global_avg_pool(x)
+    if pooling == "max":
+        return nn.global_max_pool(x)
+    return x

@@ -1,0 +1,88 @@
+"""MobileNetV2 (alpha=1.0) as a pure JAX build function.
+
+Beyond-reference zoo breadth: the reference registry stops at
+InceptionV3/Xception/ResNet50/VGG16/VGG19 (sparkdl
+transformers/keras_applications.py ~L60-200); MobileNetV2 is the
+edge/throughput architecture users reach for next. Structure and layer
+names mirror keras.applications.mobilenet_v2 exactly (inverted residual
+blocks: 1×1 expand → 3×3 depthwise → 1×1 linear project; ReLU6; BN
+momentum 0.999/eps 1e-3; stride-2 blocks use the asymmetric
+``correct_pad`` + VALID depthwise), so pretrained-weight conversion
+stays mechanical name-mapping.
+"""
+
+from __future__ import annotations
+
+from tpudl.zoo import nn
+from tpudl.zoo.core import Store
+
+NAME = "MobileNetV2"
+INPUT_SIZE = (224, 224)
+FEATURE_DIM = 1280
+PREPROCESS_MODE = "tf"
+
+# (filters, stride, expansion) per inverted-residual block, ids 0..16
+_BLOCKS = [
+    (16, 1, 1),
+    (24, 2, 6), (24, 1, 6),
+    (32, 2, 6), (32, 1, 6), (32, 1, 6),
+    (64, 2, 6), (64, 1, 6), (64, 1, 6), (64, 1, 6),
+    (96, 1, 6), (96, 1, 6), (96, 1, 6),
+    (160, 2, 6), (160, 1, 6), (160, 1, 6),
+    (320, 1, 6),
+]
+
+
+def _correct_pad(x, kernel=3):
+    """keras imagenet_utils.correct_pad for channels-last inputs."""
+    h, w = x.shape[1], x.shape[2]
+    adjust = (1 - h % 2, 1 - w % 2)
+    correct = (kernel // 2, kernel // 2)
+    return ((correct[0] - adjust[0], correct[0]),
+            (correct[1] - adjust[1], correct[1]))
+
+
+def _inverted_res_block(s, x, *, filters, stride, expansion, block_id):
+    in_channels = x.shape[-1]
+    prefix = f"block_{block_id}_" if block_id else "expanded_conv_"
+    inputs = x
+    if block_id:
+        x = s.conv(x, expansion * in_channels, 1, use_bias=False,
+                   name=f"{prefix}expand")
+        x = s.bn(x, momentum=0.999, name=f"{prefix}expand_BN")
+        x = nn.relu6(x)
+    if stride == 2:
+        x = nn.zero_pad(x, _correct_pad(x))
+    x = s.depthwise_conv(x, 3, strides=(stride, stride),
+                         padding="SAME" if stride == 1 else "VALID",
+                         use_bias=False, name=f"{prefix}depthwise")
+    x = s.bn(x, momentum=0.999, name=f"{prefix}depthwise_BN")
+    x = nn.relu6(x)
+    x = s.conv(x, filters, 1, use_bias=False, name=f"{prefix}project")
+    x = s.bn(x, momentum=0.999, name=f"{prefix}project_BN")
+    if in_channels == filters and stride == 1:
+        return inputs + x
+    return x
+
+
+def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    x = s.conv(x, 32, 3, strides=(2, 2), padding="SAME", use_bias=False,
+               name="Conv1")
+    x = s.bn(x, momentum=0.999, name="bn_Conv1")
+    x = nn.relu6(x)
+    for block_id, (filters, stride, expansion) in enumerate(_BLOCKS):
+        x = _inverted_res_block(s, x, filters=filters, stride=stride,
+                                expansion=expansion, block_id=block_id)
+    x = s.conv(x, 1280, 1, use_bias=False, name="Conv_1")
+    x = s.bn(x, momentum=0.999, name="Conv_1_bn")
+    x = nn.relu6(x)
+
+    if include_top:
+        x = nn.global_avg_pool(x)
+        x = s.dense(x, classes, name="predictions")
+        return nn.softmax(x)
+    if pooling == "avg":
+        return nn.global_avg_pool(x)
+    if pooling == "max":
+        return nn.global_max_pool(x)
+    return x

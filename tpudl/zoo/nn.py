@@ -34,6 +34,7 @@ __all__ = [
     "global_max_pool",
     "zero_pad",
     "relu",
+    "relu6",
     "softmax",
 ]
 
@@ -159,6 +160,11 @@ def zero_pad(x, pad):
 
 def relu(x):
     return jax.nn.relu(x)
+
+
+def relu6(x):
+    """Keras ReLU(6.0) — the MobileNet activation."""
+    return jnp.minimum(jax.nn.relu(x), 6.0)
 
 
 def softmax(x):

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpudl.zoo import inception_v3, resnet, vgg, xception
+from tpudl.zoo import (densenet, inception_v3, mobilenet_v2, resnet, vgg,
+                       xception)
 from tpudl.zoo.core import Store
 from tpudl.zoo.preprocessing import preprocess_input
 
@@ -55,6 +56,8 @@ class NamedModel:
             "ResNet50": "resnet50",
             "VGG16": "vgg16",
             "VGG19": "vgg19",
+            "MobileNetV2": "mobilenet_v2",
+            "DenseNet121": "densenet",
         }[self.name]
 
     @property
@@ -62,8 +65,25 @@ class NamedModel:
         """Keras layer whose output IS the DeepImageFeaturizer vector —
         the ONE definition the golden generator and the harness
         self-check must both cut at (post-relu fc2 for VGG, avg_pool for
-        the conv nets; mirrors :meth:`featurize`)."""
-        return "fc2" if self.name.startswith("VGG") else "avg_pool"
+        the conv nets; mirrors :meth:`featurize`). A 4-D cut output
+        (MobileNetV2's out_relu — its keras pool layer is auto-named,
+        so unstable to cut at) gets a GlobalAveragePooling2D appended by
+        the consumers."""
+        return {"VGG16": "fc2", "VGG19": "fc2",
+                "MobileNetV2": "out_relu"}.get(self.name, "avg_pool")
+
+    def feature_cut_model(self, km):
+        """keras Model emitting THE featurizer vector from ``km`` — the
+        single definition of the oracle cut, shared by the golden
+        generator and the harness self-check so they can never drift: a
+        4-D cut output (MobileNetV2) gets global average pooling
+        appended, matching :meth:`featurize`."""
+        import keras
+
+        cut = km.get_layer(self.feature_cut).output
+        if len(cut.shape) == 4:
+            cut = keras.layers.GlobalAveragePooling2D()(cut)
+        return keras.Model(km.input, cut)
 
     # -- params ----------------------------------------------------------
     def init(self, rng, *, image_size: tuple[int, int] | None = None,
@@ -133,6 +153,8 @@ class NamedModel:
             "ResNet50": keras.applications.ResNet50,
             "VGG16": keras.applications.VGG16,
             "VGG19": keras.applications.VGG19,
+            "MobileNetV2": keras.applications.MobileNetV2,
+            "DenseNet121": keras.applications.DenseNet121,
         }[self.name]
 
 
@@ -149,6 +171,12 @@ SUPPORTED_MODELS: dict[str, NamedModel] = {
                    vgg.PREPROCESS_MODE),
         NamedModel("VGG19", vgg.build_vgg19, vgg.INPUT_SIZE, 4096,
                    vgg.PREPROCESS_MODE),
+        # beyond the reference registry (which stops at the 5 above)
+        NamedModel("MobileNetV2", mobilenet_v2.build,
+                   mobilenet_v2.INPUT_SIZE, mobilenet_v2.FEATURE_DIM,
+                   mobilenet_v2.PREPROCESS_MODE),
+        NamedModel("DenseNet121", densenet.build, densenet.INPUT_SIZE,
+                   densenet.FEATURE_DIM, densenet.PREPROCESS_MODE),
     ]
 }
 
