@@ -8,7 +8,8 @@ scan/ppermute GPipe schedule for PP. This example trains/runs a small
 causal LM under each composition and checks them against the plain
 single-device run.
 
-Run on any device count (uses an 8-way virtual CPU mesh if needed):
+Needs an even device count >= 4; on a 1-device host run with a virtual
+CPU mesh:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/parallelism_matrix.py
 """
@@ -29,13 +30,16 @@ from tpudl.zoo.transformer import TinyCausalLM
 
 
 def main():
-    if jax.device_count() < 4:
-        print(f"only {jax.device_count()} device(s); this example needs >=4 "
-              "(see the XLA_FLAGS line in the docstring)")
+    if jax.device_count() < 4 or jax.device_count() % 2:
+        print(f"{jax.device_count()} device(s); this example needs an even "
+              "count >=4 (see the XLA_FLAGS line in the docstring)")
         return
-    mesh = M.build_mesh(n_data=jax.device_count() // 2, n_model=2)
+    n_data = jax.device_count() // 2
+    mesh = M.build_mesh(n_data=n_data, n_model=2)
     print(f"mesh: {dict(mesh.shape)}")
-    toks = np.random.default_rng(0).integers(0, 32, (8, 33), np.int32)
+    # batch divides the data axis; seq-1 divides the ring size
+    toks = np.random.default_rng(0).integers(
+        0, 32, (2 * n_data, 4 * n_data + 1), np.int32)
 
     # -- DP x SP(ring) x TP(Megatron) ------------------------------------
     lm = TinyCausalLM(vocab=32, dim=32, heads=4, layers=2)

@@ -8,8 +8,7 @@ named_image.py ~L120). These are the classic silent-mismatch spots
 
 - ``tf``    : x/127.5 - 1, RGB input            (InceptionV3, Xception)
 - ``caffe`` : RGB→BGR, subtract ImageNet means  (ResNet50, VGG16, VGG19)
-- ``torch`` : x/255 then per-channel mean/std   (not used by the zoo, kept
-              for API parity)
+- ``torch`` : x/255 then per-channel mean/std   (DenseNet121)
 
 All fns are jittable and assume float input in [0, 255] **RGB** channel
 order (convert from BGR storage first via tpudl.image.ops).
