@@ -134,13 +134,78 @@ def main():
         # unicode dtype (not object) so the parent's np.load needs no pickle
         extra["shard_paths"] = np.asarray([str(p) for p in shard["filePath"]])
 
+    # --- cross-host SEQUENCE parallelism: ring attention over the
+    # GLOBAL mesh — the K/V ppermute hops cross the process boundary on
+    # the distributed backend (the DCN stand-in). Each worker checks its
+    # ADDRESSABLE output shards against the locally-computed dense
+    # oracle at the shard's global index.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudl.attention import attention_reference, ring_attention
+
+    rng3 = np.random.default_rng(7)
+    s_glob = 4 * jax.device_count()
+    q, k, v = (rng3.normal(size=(2, s_glob, 2, 8)).astype(np.float32)
+               for _ in range(3))
+    seq_sh = NamedSharding(mesh, P(None, M.DATA_AXIS, None, None))
+
+    def to_global(a):
+        return jax.make_array_from_callback(a.shape, seq_sh,
+                                            lambda idx: a[idx])
+
+    ring = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh,
+                                                  causal=True))(
+        to_global(q), to_global(k), to_global(v))
+    dense = np.asarray(attention_reference(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True))
+    sp_ring_ok = all(
+        np.allclose(np.asarray(sh_.data), dense[sh_.index],
+                    rtol=2e-4, atol=2e-4)
+        for sh_ in ring.addressable_shards)
+
+    # --- cross-host TENSOR parallelism: Megatron-sharded TinyCausalLM
+    # train step on a (n/2)×2 mesh — the textbook layout (TP pairs
+    # intra-host, the gradient allreduce crossing hosts on the data
+    # axis). Params enter via device_put with the TP shardings (each
+    # process materializes only its addressable shards).
+    import optax
+
+    from tpudl.train import make_train_step
+    from tpudl.zoo.transformer import TinyCausalLM
+
+    lm = TinyCausalLM(vocab=32, dim=16, heads=2, layers=1)
+    lm_params = lm.init(0)
+    n_dp = jax.device_count() // 2
+    mesh_tp = M.build_mesh(n_data=n_dp, n_model=2)
+    tp_step = make_train_step(
+        lm.loss_fn(mesh=mesh_tp, tp=True), optax.sgd(0.05), mesh=mesh_tp,
+        param_shardings=lm.param_shardings(mesh_tp))
+    toks = np.random.default_rng(8).integers(
+        0, 32, size=(n_dp, 2 * n_dp + 1)).astype(np.int32)
+    with M.use_mesh(mesh_tp):
+        p_tp = lm.shard_params(lm_params, mesh_tp)
+        wq_cols = p_tp["block_0"]["wq"].addressable_shards[0].data.shape[1]
+        rows_per_proc = n_dp // args.num_processes
+        p_tp2, _o, l_tp = tp_step(p_tp, optax.sgd(0.05).init(p_tp),
+                                  D.global_batch(
+                                      toks[args.process_id * rows_per_proc:
+                                           (args.process_id + 1)
+                                           * rows_per_proc], mesh_tp))
+        tp_loss = float(jax.device_get(l_tp))
+    wq2_cols = p_tp2["block_0"]["wq"].addressable_shards[0].data.shape[1]
+
     np.savez(args.out, w=w,
              process_count=jax.process_count(),
              process_index=jax.process_index(),
              local_devices=jax.local_device_count(),
              global_devices=jax.device_count(),
+             sp_ring_ok=np.asarray(int(sp_ring_ok)),
+             tp_loss=np.asarray(tp_loss, np.float64),
+             tp_wq_shard_cols=np.asarray(wq_cols),
+             tp_wq_shard_cols_after=np.asarray(wq2_cols),
              **extra)
-    print(f"worker {args.process_id}: done, |w|={np.abs(w).sum():.6f}")
+    print(f"worker {args.process_id}: done, |w|={np.abs(w).sum():.6f}, "
+          f"sp_ring_ok={sp_ring_ok}, tp_loss={tp_loss:.4f}")
 
 
 if __name__ == "__main__":
