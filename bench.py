@@ -630,7 +630,7 @@ def measure_resnet50_convergence(dtype):
     import jax
 
     from tpudl.train import make_train_step
-    from tpudl.zoo.registry import cast_params, getKerasApplicationModel
+    from tpudl.zoo.registry import getKerasApplicationModel
 
     steps = int(os.environ.get("TPUDL_BENCH_CURVE_STEPS", "120"))
     batch = int(os.environ.get("TPUDL_BENCH_CURVE_BATCH", "32"))
@@ -647,10 +647,10 @@ def measure_resnet50_convergence(dtype):
         xs.append(x)
         ys.append(np.eye(1000, dtype=np.float32)[cls])
 
+    from tpudl.train import with_compute_dtype
+
     model = getKerasApplicationModel("ResNet50")
-    params = model.init(0)
-    if dtype != "float32":
-        params = cast_params(params, dtype)
+    params = model.init(0)  # fp32 MASTER weights (see below)
 
     def loss_fn(p, x, y):
         x = (x.astype(jnp.dtype(dtype)) - 127.5) / 127.5
@@ -658,9 +658,15 @@ def measure_resnet50_convergence(dtype):
         logp = jnp.log(jnp.clip(logits, 1e-7, 1.0))
         return -jnp.mean(jnp.sum(y * logp, axis=-1))
 
+    # mixed precision: dtype (bf16) compute on fp32 masters — training
+    # the masters IN bf16 stalls once SGD updates drop below the 8-bit
+    # mantissa ULP (the earlier plateau at ~4.2; proven in
+    # tests/test_train.py::TestMixedPrecision)
+    train_loss = (with_compute_dtype(loss_fn, dtype)
+                  if dtype != "float32" else loss_fn)
     opt = optax.sgd(0.05)
-    step = make_train_step(loss_fn, opt)
-    eval_fn = jax.jit(loss_fn)
+    step = make_train_step(train_loss, opt)
+    eval_fn = jax.jit(train_loss)
     x0, y0 = jax.device_put((xs[0], ys[0]))  # the fixed eval batch
     p = jax.device_put(params)
     o = opt.init(p)
@@ -901,7 +907,8 @@ def measure_flash_attention():
     b, h, d = 1, 8, 128
     s_ladder = ([256] if interpret else
                 [int(s) for s in os.environ.get(
-                    "TPUDL_BENCH_FLASH_SEQS", "2048,4096,8192").split(",")])
+                    "TPUDL_BENCH_FLASH_SEQS",
+                    "2048,4096,8192,16384").split(",")])
     reps = 8
     rng = np.random.default_rng(1)
     ladder = []

@@ -156,6 +156,70 @@ class TestCheckpointResume:
             jax.tree.map(lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-7), p2a, p2b)
 
+    def test_tp_trainer_resume_equivalence(self, tmp_path, mesh4x2):
+        """TP through the STANDARD Trainer: param_shardings plumbed
+        end-to-end — 6 straight steps == 3 + checkpoint-resume + 3, and
+        the trained params are still column-sharded."""
+        optax = _optax()
+        from tpudl.zoo.transformer import TinyCausalLM
+
+        lm = TinyCausalLM(vocab=16, dim=16, heads=2, layers=1)
+        params0 = lm.init(0)
+        toks = np.random.default_rng(1).integers(0, 16, (8, 17),
+                                                 dtype=np.int32)
+        data = lambda s: (toks,)  # noqa: E731
+        opt = optax.adam(1e-2)
+        sh = lm.param_shardings(mesh4x2)
+
+        t_straight = Trainer(lm.loss_fn(mesh=mesh4x2, tp=True), opt,
+                             mesh=mesh4x2, param_shardings=sh)
+        p_straight, _, _ = t_straight.fit(params0, data, steps=6)
+        assert (p_straight["block_0"]["wq"].addressable_shards[0]
+                .data.shape == (16, 8))
+
+        d = str(tmp_path / "tp_resume")
+        t_a = Trainer(lm.loss_fn(mesh=mesh4x2, tp=True), opt,
+                      mesh=mesh4x2, param_shardings=sh,
+                      checkpoint_dir=d, save_every=100)
+        t_a.fit(params0, data, steps=3)  # force-save at 3
+        t_b = Trainer(lm.loss_fn(mesh=mesh4x2, tp=True), opt,
+                      mesh=mesh4x2, param_shardings=sh,
+                      checkpoint_dir=d, save_every=100)
+        p_resumed, _, _ = t_b.fit(params0, data, steps=6)
+        assert (p_resumed["block_0"]["wq"].addressable_shards[0]
+                .data.shape == (16, 8))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            p_straight, p_resumed)
+
+    def test_tp_chained_fit_keeps_opt_state_sharded(self, mesh4x2):
+        """fit → fit(opt_state=...) with TP: the passed-back state's
+        adam moments must STAY model-sharded (an np.asarray ownership
+        copy would gather them and the second fit would replicate) and
+        the caller's buffers must survive the donation."""
+        optax = _optax()
+        from tpudl.zoo.transformer import TinyCausalLM
+
+        lm = TinyCausalLM(vocab=16, dim=16, heads=2, layers=1)
+        toks = np.random.default_rng(2).integers(0, 16, (8, 17),
+                                                 dtype=np.int32)
+        tr = Trainer(lm.loss_fn(mesh=mesh4x2, tp=True), optax.adam(1e-2),
+                     mesh=mesh4x2,
+                     param_shardings=lm.param_shardings(mesh4x2))
+        p, o, _ = tr.fit(lm.init(0), lambda s: (toks,), steps=2)
+        mu = o[0].mu["block_0"]["wq"]
+        assert mu.addressable_shards[0].data.shape == (16, 8)
+        p2, o2, _ = tr.fit(p, lambda s: (toks,), steps=2, opt_state=o)
+        # caller's state survived (fresh owned buffers were donated, not
+        # the caller's) ...
+        assert np.isfinite(np.asarray(mu)).all()
+        # ... and the moments are STILL model-sharded after round-trip
+        mu2 = o2[0].mu["block_0"]["wq"]
+        assert mu2.addressable_shards[0].data.shape == (16, 8)
+        assert (p2["block_0"]["wq"].addressable_shards[0].data.shape
+                == (16, 8))
+
     def test_resume_equivalence(self, tmp_path, mesh8):
         """Train 20 straight vs 10 + restore + 10 more → identical params
         (SURVEY.md §5.3 resume-equivalence assertion)."""
@@ -178,6 +242,52 @@ class TestCheckpointResume:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-6),
             p_straight, p_resumed)
+
+
+class TestMixedPrecision:
+    def test_bf16_master_loses_small_updates_fp32_master_keeps_them(self):
+        """The failure mode with_compute_dtype exists for: an SGD update
+        below the bf16 ULP rounds to NOTHING on bf16 master weights but
+        accumulates on fp32 masters with bf16 compute."""
+        optax = _optax()
+        from tpudl.train import make_train_step, with_compute_dtype
+
+        # loss = 1e-4 * w  ->  grad = 1e-4; lr 1e-2  ->  update 1e-6,
+        # far below bf16's ULP at 1.0 (~7.8e-3)
+        def loss(p, _x):
+            return 1e-4 * jnp.sum(p["w"])
+
+        opt = optax.sgd(1e-2)
+        x = np.zeros(1, np.float32)
+
+        p_bf = {"w": jnp.ones(4, jnp.bfloat16)}
+        step_bf = make_train_step(loss, opt, donate=False)
+        p1, _, _ = step_bf(p_bf, opt.init(p_bf), x)
+        np.testing.assert_array_equal(  # the update vanished
+            np.asarray(p1["w"], np.float32), np.ones(4, np.float32))
+
+        p_fp = {"w": jnp.ones(4, jnp.float32)}
+        step_mp = make_train_step(with_compute_dtype(loss, jnp.bfloat16),
+                                  opt, donate=False)
+        p2, _, _ = step_mp(p_fp, opt.init(p_fp), x)
+        np.testing.assert_allclose(  # fp32 master kept it
+            np.asarray(p2["w"]), np.full(4, 1.0 - 1e-6, np.float32),
+            rtol=0, atol=1e-9)
+
+    def test_compute_really_runs_in_bf16(self):
+        from tpudl.train import with_compute_dtype
+
+        seen = {}
+
+        def loss(p, x):
+            seen["dtype"] = p["w"].dtype
+            return jnp.sum(p["w"]) + jnp.sum(x)
+
+        wrapped = with_compute_dtype(loss, jnp.bfloat16)
+        g = jax.grad(wrapped)({"w": jnp.ones(3, jnp.float32)},
+                              jnp.zeros(2))
+        assert seen["dtype"] == jnp.bfloat16
+        assert g["w"].dtype == jnp.float32  # grads land on the masters
 
 
 class TestFaultRecovery:

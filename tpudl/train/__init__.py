@@ -8,7 +8,8 @@ see :mod:`tpudl.train.runner` (Runner/Trainer), :mod:`tpudl.train.step`
 
 from tpudl.train.checkpoint import CheckpointManager
 from tpudl.train.runner import HorovodRunner, TrainContext, Trainer
-from tpudl.train.step import make_eval_step, make_train_step
+from tpudl.train.step import (make_eval_step, make_train_step,
+                              with_compute_dtype)
 
 __all__ = [
     "HorovodRunner",
@@ -17,4 +18,5 @@ __all__ = [
     "CheckpointManager",
     "make_train_step",
     "make_eval_step",
+    "with_compute_dtype",
 ]

@@ -129,30 +129,93 @@ class Trainer:
     """
 
     def __init__(self, loss_fn, optimizer, *, mesh=None,
-                 checkpoint_dir=None, save_every=100, log_every=0):
+                 checkpoint_dir=None, save_every=100, log_every=0,
+                 param_shardings=None):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.checkpoint_dir = checkpoint_dir
         self.save_every = save_every
         self.log_every = log_every
+        # tensor parallelism through the standard Trainer: a pytree of
+        # NamedSharding matching params (e.g. TinyCausalLM
+        # .param_shardings(mesh)) — params and optimizer state then live
+        # SHARDED over the model axis for the whole fit, checkpoints
+        # included (orbax round-trips the shardings via `like`)
+        self.param_shardings = param_shardings
+        if param_shardings is not None and mesh is None:
+            raise ValueError(
+                "param_shardings without mesh= would be silently ignored "
+                "— pass the mesh the shardings were built on")
         self.history: list[dict] = []
         # one compiled SPMD program per Trainer: rebuilding the jit wrapper
         # per fit() would retrace+recompile every call (loss_fn/optimizer/
         # mesh are fixed at construction, so the program is too)
-        self._step_fn = make_train_step(loss_fn, optimizer, mesh)
+        self._step_fn = make_train_step(loss_fn, optimizer, mesh,
+                                        param_shardings=param_shardings)
 
     def fit(self, params, data_fn, steps: int, *, opt_state=None):
         """Train for ``steps`` total steps (resuming included). Returns
         (params, opt_state, history)."""
-        opt_state = (self.optimizer.init(params)
-                     if opt_state is None else opt_state)
         self.history = []  # per-fit; stale entries would misreport results
+
+        # own the buffers: the step donates params/opt_state, and device_put
+        # may alias the caller's arrays — donating an alias would delete the
+        # caller's data out from under them. Host arrays are copied
+        # host-side; mesh-spanning device trees are copied by a jitted
+        # identity (fresh output buffers, SAME shardings — an np.asarray
+        # here would gather a TP-sharded state to host, losing its
+        # layout and failing outright on multi-host non-addressable
+        # shards).
+        mesh_devices = (set(self.mesh.devices.flat)
+                        if self.mesh is not None else None)
+
+        def _spans_mesh(x):
+            sh = getattr(x, "sharding", None)
+            return (sh is not None and mesh_devices is not None
+                    and sh.device_set == mesh_devices)
+
+        def _own(tree):
+            if all(_spans_mesh(leaf) for leaf in jax.tree.leaves(tree)):
+                return jax.jit(lambda t: t)(tree)
+            return jax.tree.map(np.asarray, tree)
+
+        params = _own(params)
+        if opt_state is not None:
+            opt_state = _own(opt_state)
+        if self.mesh is not None and not all(
+                _spans_mesh(leaf) for leaf in jax.tree.leaves(params)):
+            if self.param_shardings is not None:
+                params = jax.tree.map(jax.device_put, params,
+                                      self.param_shardings)
+                # an opt_state built from SHARDED params gets sharded
+                # moment buffers for free
+            else:
+                params = M.replicate(params, self.mesh)
+        if opt_state is None:
+            opt_state = self.optimizer.init(params)
+        if self.mesh is not None:
+            # optax states mix param-shaped buffers (already placed via
+            # zeros_like of the placed params) with FRESH scalars (adam's
+            # `count`) that land on one default device — a mixed-device
+            # jit call is an error. Leaves not spanning the mesh get
+            # replicated; mesh-spanning (sharded) leaves pass through
+            # with their layout intact.
+            def _mesh_place(x):
+                if _spans_mesh(x):
+                    return x
+                return jax.device_put(np.asarray(x),
+                                      M.replicated(self.mesh))
+
+            opt_state = jax.tree.map(_mesh_place, opt_state)
+
         start = 0
         mgr = None
         if self.checkpoint_dir is not None:
             mgr = CheckpointManager(self.checkpoint_dir,
                                     save_every=self.save_every)
+            # `like` is built AFTER placement, so restored arrays come
+            # back with the same (possibly TP-sharded) shardings
             like = {"params": params, "opt_state": opt_state,
                     "step": np.asarray(0, np.int64)}
             restored = mgr.restore(like=like)
@@ -161,17 +224,11 @@ class Trainer:
                 opt_state = restored["opt_state"]
                 start = int(restored["step"])
                 log.info("resumed from checkpoint at step %d", start)
+            # the pre-restore placed buffers (still referenced by `like`)
+            # would otherwise pin ~2x params+opt HBM for the whole fit
+            del like, restored
 
         step_fn = self._step_fn
-        # own the buffers: the step donates params/opt_state, and device_put
-        # may alias the caller's arrays — donating an alias would delete the
-        # caller's data out from under them. Host-side copy is placement-
-        # neutral (valid under any active mesh context).
-        params = jax.tree.map(np.asarray, params)
-        opt_state = jax.tree.map(np.asarray, opt_state)
-        if self.mesh is not None:
-            params = M.replicate(params, self.mesh)
-            opt_state = M.replicate(opt_state, self.mesh)
 
         # Multi-host: data_fn returns THIS host's slice of the global
         # batch (use tpudl.distributed.host_shard to pick the host's
