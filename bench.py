@@ -921,28 +921,47 @@ def measure_flash_attention():
         dense = jax.jit(lambda a, x, y: jnp.sum(
             attention_reference(a, x, y, causal=True)))
 
-        def timed(fn):
-            float(fn(q, k, v))  # compile+warm
+        def timed(compiled):
+            float(compiled(q, k, v))  # warm (already compiled AOT)
             vals = []
             for _ in range(3):
                 t0 = time.perf_counter()
                 acc = jnp.zeros(())
                 for _ in range(reps):
-                    acc = acc + fn(q, k, v)
+                    acc = acc + compiled(q, k, v)
                 float(acc)
                 vals.append((time.perf_counter() - t0) / reps)
             return statistics.median(vals) * 1e3
 
         entry = {"seq_len": s}
-        try:
-            entry["flash_ms"] = round(timed(flash), 2)
-        except Exception as e:
-            entry["flash_error"] = repr(e)[:200]
-        try:
-            entry["dense_ms"] = round(timed(dense), 2)
-        except Exception as e:
-            # dense falling over at long S IS the result being measured
-            entry["dense_error"] = repr(e)[:200]
+        for kind, fn in (("flash", flash), ("dense", dense)):
+            # ONE AOT compile serves both the memory record and the
+            # timing (a second jit-path compile would double the rung's
+            # compile cost at long S)
+            try:
+                compiled = fn.lower(q, k, v).compile()
+            except Exception as e:
+                entry[f"{kind}_error"] = repr(e)[:200]
+                continue
+            try:
+                # compiler-certified STRUCTURAL memory: XLA's own
+                # memory_analysis (static — immune to tunnel timing
+                # weather). The S² score materialization lives in temp;
+                # the flash kernel's VMEM tiles do not. Recorded even
+                # when EXECUTION below fails — a dense OOM at long S is
+                # exactly when this number is the result.
+                ma = compiled.memory_analysis()
+                if ma:
+                    entry[f"{kind}_temp_mb"] = round(
+                        ma.temp_size_in_bytes / 2**20, 1)
+            except Exception as e:
+                log(f"memory_analysis failed: {e!r}")
+            try:
+                entry[f"{kind}_ms"] = round(timed(compiled), 2)
+            except Exception as e:
+                # dense falling over at long S IS a result; keep it
+                # alongside the structural temp bytes above
+                entry[f"{kind}_error"] = repr(e)[:200]
         if "flash_ms" in entry and "dense_ms" in entry:
             entry["speedup"] = round(entry["dense_ms"] / entry["flash_ms"],
                                      2)

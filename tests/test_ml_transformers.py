@@ -212,6 +212,25 @@ class TestNamedImage:
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
         assert got.shape == (4, 2048)
 
+    def test_tf_image_transformer_warmup(self):
+        """The generic graph transformer shares the no-fetch warm path
+        (ImageBatchWarmup): warmup then transform matches cold."""
+        import jax.numpy as jnp
+
+        from tpudl.ml import TFImageTransformer
+
+        frame = _image_frame(n=4, h=24, w=24, seed=9)
+        g = lambda x: jnp.tanh(x.reshape(x.shape[0], -1) @  # noqa: E731
+                               jnp.ones((24 * 24 * 3, 5)) * 1e-3)
+        warm = TFImageTransformer(inputCol="image", outputCol="y",
+                                  graph=g, batchSize=4)
+        assert warm.warmup(24, 24) is warm
+        got = np.stack(list(warm.transform(frame)["y"]))
+        cold = TFImageTransformer(inputCol="image", outputCol="y",
+                                  graph=g, batchSize=4)
+        want = np.stack(list(cold.transform(frame)["y"]))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
     def test_warmup_no_fetch_then_transform_matches(self):
         """``warmup`` compiles+executes WITHOUT any device→host read (the
         streaming-mode-preserving warm path, BASELINE.md two-mode model)

@@ -220,6 +220,26 @@ class TestCheckpointResume:
         assert (p2["block_0"]["wq"].addressable_shards[0].data.shape
                 == (16, 8))
 
+    def test_tp_host_opt_state_comes_back_sharded(self, mesh4x2):
+        """A HOST-array opt_state passed to a TP Trainer must enter the
+        step with its param-shaped moments model-SHARDED (replicated
+        fp32 moments would defeat TP's memory point)."""
+        optax = _optax()
+        from tpudl.zoo.transformer import TinyCausalLM
+
+        lm = TinyCausalLM(vocab=16, dim=16, heads=2, layers=1)
+        params0 = lm.init(0)
+        toks = np.random.default_rng(3).integers(0, 16, (8, 17),
+                                                 dtype=np.int32)
+        host_opt = optax.adam(1e-2).init(params0)  # pure numpy leaves
+        tr = Trainer(lm.loss_fn(mesh=mesh4x2, tp=True), optax.adam(1e-2),
+                     mesh=mesh4x2,
+                     param_shardings=lm.param_shardings(mesh4x2))
+        p, o, _ = tr.fit(params0, lambda s: (toks,), steps=1,
+                         opt_state=host_opt)
+        assert (o[0].mu["block_0"]["wq"].addressable_shards[0].data.shape
+                == (16, 8)), "host moments entered replicated, not sharded"
+
     def test_resume_equivalence(self, tmp_path, mesh8):
         """Train 20 straight vs 10 + restore + 10 more → identical params
         (SURVEY.md §5.3 resume-equivalence assertion)."""

@@ -26,7 +26,7 @@ from tpudl.image import ops as image_ops
 from tpudl.ml.params import (HasInputCol, HasOutputCol, Param,
                              TypeConverters, keyword_only)
 from tpudl.ml.pipeline import Transformer
-from tpudl.ml.tf_image import _pack_image_structs
+from tpudl.ml.tf_image import ImageBatchWarmup, _pack_image_structs
 from tpudl.zoo.preprocessing import decode_predictions
 from tpudl.zoo.registry import SUPPORTED_MODELS, getKerasApplicationModel
 
@@ -103,10 +103,12 @@ def _check_compute_dtype(value: str) -> str:
     return value
 
 
-class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
+class _NamedImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
+                             HasOutputCol):
     """Shared engine (ref: named_image.py _NamedImageTransformer): packs
     the image column, runs ONE fused program —
-    uint8 batch → float → resize(model geometry) → preprocess → net."""
+    uint8 batch → float → resize(model geometry) → preprocess → net.
+    ``warmup(h, w)`` (ImageBatchWarmup) compiles without fetching."""
 
     modelName = Param(None, "modelName", "named model from the zoo registry",
                       TypeConverters.supportedNameConverter(SUPPORTED_MODELS))
@@ -161,37 +163,6 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
         else:  # file-backed weights may be rewritten between calls
             key = (name, self.weights, dtype, os.path.getmtime(self.weights))
         return self._cached_jit(key, build)
-
-    def warmup(self, height, width, nChannels=3, dtype=np.uint8):
-        """Compile and warm the fused program for (height, width,
-        nChannels) input images WITHOUT any device→host read.
-
-        On tunneled/remote PJRT backends the process's FIRST device→host
-        fetch permanently switches the channel from pipelined streaming
-        to per-transfer synchronization (BASELINE.md "two transfer
-        modes"; uploads drop from 300–1500 to 3–20 MB/s). Warming up by
-        running ``transform`` ends with exactly such a fetch. This
-        method instead executes the program once on a synthetic batch
-        and discards the device result unread — executions do not
-        trigger the mode switch — so a fresh process that calls
-        ``warmup(...)`` and then ``transform(frame)`` keeps every upload
-        pipelined until the transform's single final fetch.
-
-        Call with the shape of the frame's images (pre-resize: the
-        on-device pipeline resizes to the model geometry, so the traced
-        signature is the *input* shape). Returns ``self`` for chaining.
-        """
-        import jax
-
-        jfn = self._get_jfn()
-        x = np.zeros((self.batchSize, height, width, nChannels), dtype=dtype)
-        if self.mesh is not None:
-            from tpudl import mesh as M
-
-            x, _ = M.pad_batch(x, self.mesh.shape[M.DATA_AXIS])
-            x = M.shard_batch(x, self.mesh)
-        jax.block_until_ready(jfn(x))  # compile + execute; never fetched
-        return self
 
     def _apply_batches(self, frame, out_col):
         jfn = self._get_jfn()

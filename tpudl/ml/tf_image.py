@@ -26,8 +26,47 @@ __all__ = ["TFImageTransformer"]
 OUTPUT_MODES = ("vector", "image")
 
 
-class TFImageTransformer(Transformer, HasInputCol, HasOutputCol,
-                         HasOutputMode):
+class ImageBatchWarmup:
+    """Mixin: no-fetch warm path for image-batch transformers.
+
+    Requires ``_get_jfn()`` (the fused jitted program), ``batchSize``
+    and ``mesh`` on the host class.
+    """
+
+    def warmup(self, height, width, nChannels=3, dtype=np.uint8):
+        """Compile and warm the fused program for (height, width,
+        nChannels) input images WITHOUT any device→host read.
+
+        On tunneled/remote PJRT backends the process's FIRST device→host
+        fetch permanently switches the channel from pipelined streaming
+        to per-transfer synchronization (BASELINE.md "two transfer
+        modes"). Warming up by running ``transform`` ends with exactly
+        such a fetch. This method instead executes the program once on a
+        synthetic batch and discards the device result unread —
+        executions do not trigger the mode switch — so a fresh process
+        that calls ``warmup(...)`` and then ``transform(frame)`` keeps
+        every upload pipelined until the transform's single final fetch.
+
+        Call with the shape of the frame's images (pre-resize where the
+        on-device pipeline resizes: the traced signature is the *input*
+        shape). Only the full-batch signature is warmed; a ragged tail
+        batch compiles during the transform (compiles don't fetch, so
+        streaming mode survives that too). Returns ``self``.
+        """
+        jfn = self._get_jfn()
+        x = np.zeros((self.batchSize, height, width, nChannels),
+                     dtype=dtype)
+        if self.mesh is not None:
+            from tpudl import mesh as M
+
+            x, _ = M.pad_batch(x, self.mesh.shape[M.DATA_AXIS])
+            x = M.shard_batch(x, self.mesh)
+        jax.block_until_ready(jfn(x))  # compile + execute; never fetched
+        return self
+
+
+class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
+                         HasOutputCol, HasOutputMode):
     """Applies a model function to an image column.
 
     Params (ref spelling kept: tf_image.py ~L50):
@@ -87,9 +126,7 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol,
         raise TypeError(
             f"graph param must be TFInputGraph or callable, got {type(g).__name__}")
 
-    def _transform(self, frame):
-        in_col = self.getInputCol()
-        out_col = self.getOutputCol()
+    def _get_jfn(self):
         order = self.getOrDefault(self.channelOrder)
         mode = self.getOutputMode()
 
@@ -107,10 +144,16 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol,
 
             return fn
 
-        jfn = self._cached_jit(
+        return self._cached_jit(
             (self.getOrDefault(self.graph),
              self._paramMap.get(self.inputTensor),
              self._paramMap.get(self.outputTensor), order, mode), build)
+
+    def _transform(self, frame):
+        in_col = self.getInputCol()
+        out_col = self.getOutputCol()
+        mode = self.getOutputMode()
+        jfn = self._get_jfn()
         out = frame.map_batches(
             jfn, [in_col], [out_col], batch_size=self.batchSize,
             mesh=self.mesh, pack=_pack_image_structs)

@@ -192,22 +192,32 @@ class Trainer:
                 # moment buffers for free
             else:
                 params = M.replicate(params, self.mesh)
-        if opt_state is None:
+        fresh_opt = opt_state is None
+        if fresh_opt:
             opt_state = self.optimizer.init(params)
-        if self.mesh is not None:
-            # optax states mix param-shaped buffers (already placed via
-            # zeros_like of the placed params) with FRESH scalars (adam's
-            # `count`) that land on one default device — a mixed-device
-            # jit call is an error. Leaves not spanning the mesh get
-            # replicated; mesh-spanning (sharded) leaves pass through
-            # with their layout intact.
-            def _mesh_place(x):
+        if self.mesh is not None and any(
+                not _spans_mesh(leaf)
+                for leaf in jax.tree.leaves(opt_state)):
+            # optax states mix param-shaped buffers with FRESH scalars
+            # (adam's `count`) that land on one default device — a
+            # mixed-device jit call is an error. Param-shaped leaves get
+            # the sharding the optimizer WOULD give them when built from
+            # the placed params (so a caller-passed host state on the TP
+            # path comes back model-SHARDED, not replicated — replicated
+            # fp32 moments defeat the point of TP); everything else is
+            # replicated. A freshly-built state is its own template.
+            template = (opt_state if fresh_opt or self.param_shardings
+                        is None else self.optimizer.init(params))
+
+            def _place_like(x, ref):
                 if _spans_mesh(x):
                     return x
-                return jax.device_put(np.asarray(x),
-                                      M.replicated(self.mesh))
+                target = (ref.sharding if _spans_mesh(ref)
+                          else M.replicated(self.mesh))
+                return jax.device_put(np.asarray(x), target)
 
-            opt_state = jax.tree.map(_mesh_place, opt_state)
+            opt_state = jax.tree.map(_place_like, opt_state, template)
+            del template
 
         start = 0
         mgr = None

@@ -41,6 +41,17 @@ class TinyCausalLM:
     :func:`ring_attention` over the mesh's data axis (the sequence must
     divide by the axis size); without, it is dense causal attention —
     identical math, proven in tests.
+
+    The full parallelism matrix hangs off this one model:
+
+    - SP: ``apply(mesh=...)`` — ring attention (+ ``use_pallas`` flash
+      tiles), ``remat=True`` for long-context activation HBM.
+    - TP: ``param_shardings``/``shard_params`` + ``apply(tp=True)`` —
+      Megatron column/row-parallel layout, GSPMD collectives.
+    - EP: ``experts=N`` — top-1 switch MoE, experts sharded over the
+      ``model`` axis (composes with ``tp=True``).
+    - PP: :meth:`apply_pipelined` — GPipe microbatch schedule over a
+      mesh axis (composes with a DP ``data_axis``).
     """
 
     def __init__(self, vocab: int = 256, dim: int = 64, heads: int = 4,
