@@ -206,8 +206,13 @@ class Trainer:
             # path comes back model-SHARDED, not replicated — replicated
             # fp32 moments defeat the point of TP); everything else is
             # replicated. A freshly-built state is its own template.
+            # abstract template: shardings only, ZERO device allocation
+            # (an eager optimizer.init here would transiently double the
+            # opt-state HBM right while the host state is loading)
             template = (opt_state if fresh_opt or self.param_shardings
-                        is None else self.optimizer.init(params))
+                        is None
+                        else jax.jit(self.optimizer.init)
+                        .eval_shape(params))
 
             def _place_like(x, ref):
                 if _spans_mesh(x):

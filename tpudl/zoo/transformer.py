@@ -258,7 +258,8 @@ class TinyCausalLM:
 
     def apply_pipelined(self, params, tokens, mesh, *,
                         pipe_axis: str = "model", n_micro: int = 2,
-                        data_axis: str | None = None):
+                        data_axis: str | None = None,
+                        remat: bool = False):
         """Forward pass with the decoder blocks PIPELINED over
         ``mesh[pipe_axis]`` (GPipe microbatch schedule,
         :func:`tpudl.pipeline.pipeline_blocks`): stage ``i`` owns blocks
@@ -294,7 +295,7 @@ class TinyCausalLM:
             lambda *xs: jnp.stack(xs),
             *[params[f"block_{i}"] for i in range(self.layers)])
         ym = pipeline_blocks(block, stacked, xm, mesh, axis=pipe_axis,
-                             data_axis=data_axis)
+                             data_axis=data_axis, remat=remat)
         x = ym.reshape(b, s, self.dim)
         x = _layer_norm(x, params["final_norm"])
         return x @ params["embed"]["table"].T              # tied head
