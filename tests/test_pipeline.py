@@ -15,9 +15,11 @@ from tpudl.zoo.transformer import TinyCausalLM
 
 
 def _sgd_step(loss, opt, p, o, t):
+    import optax
+
     l, g = jax.value_and_grad(loss)(p, t)
     up, o = opt.update(g, o, p)
-    return jax.tree.map(lambda a, u: a + u, p, up), o, l
+    return optax.apply_updates(p, up), o, l
 
 
 class TestPipelineBlocks:

@@ -235,10 +235,18 @@ class TestCheckpointResume:
         tr = Trainer(lm.loss_fn(mesh=mesh4x2, tp=True), optax.adam(1e-2),
                      mesh=mesh4x2,
                      param_shardings=lm.param_shardings(mesh4x2))
+        # steps=0: placement only — asserting AFTER a step would let
+        # XLA's output-sharding propagation mask a replicated entry
+        # (review-caught: an eval_shape template silently did exactly
+        # that)
+        _p, o0, _ = tr.fit(params0, lambda s: (toks,), steps=0,
+                           opt_state=host_opt)
+        assert (o0[0].mu["block_0"]["wq"].addressable_shards[0].data.shape
+                == (16, 8)), "host moments ENTER replicated, not sharded"
         p, o, _ = tr.fit(params0, lambda s: (toks,), steps=1,
                          opt_state=host_opt)
         assert (o[0].mu["block_0"]["wq"].addressable_shards[0].data.shape
-                == (16, 8)), "host moments entered replicated, not sharded"
+                == (16, 8))
 
     def test_resume_equivalence(self, tmp_path, mesh8):
         """Train 20 straight vs 10 + restore + 10 more → identical params

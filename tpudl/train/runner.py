@@ -206,18 +206,56 @@ class Trainer:
             # path comes back model-SHARDED, not replicated — replicated
             # fp32 moments defeat the point of TP); everything else is
             # replicated. A freshly-built state is its own template.
-            # abstract template: shardings only, ZERO device allocation
-            # (an eager optimizer.init here would transiently double the
-            # opt-state HBM right while the host state is loading)
-            template = (opt_state if fresh_opt or self.param_shardings
-                        is None
-                        else jax.jit(self.optimizer.init)
-                        .eval_shape(params))
+            # sharding template WITHOUT materializing a second opt
+            # state. Zero-allocation routes that DON'T work (tried,
+            # review-caught): eval_shape loses shardings entirely, and
+            # AOT output_shardings of optimizer.init come back
+            # replicated/single-device (XLA leaves trivial zeros_like
+            # outputs unconstrained). What does: optax embeds the
+            # params PYTREE verbatim in its moment subtrees, so a state
+            # leaf whose path ends with a param's full path (and
+            # matches its shape) takes that param's sharding; scalars
+            # and everything else replicate.
+            if fresh_opt or self.param_shardings is None:
+                # each leaf's own sharding (None for host leaves)
+                template = jax.tree.map(
+                    lambda leaf: getattr(leaf, "sharding", None),
+                    opt_state)
+            else:
+                from jax.tree_util import (tree_flatten_with_path,
+                                           tree_map_with_path)
 
-            def _place_like(x, ref):
+                sh_flat = tree_flatten_with_path(self.param_shardings)[0]
+                p_flat = tree_flatten_with_path(params)[0]
+                suffix = {tuple(str(k) for k in path): (sh, leaf.shape)
+                          for (path, sh), (_p, leaf)
+                          in zip(sh_flat, p_flat)}
+                struct = jax.eval_shape(self.optimizer.init, params)
+
+                def _sh_for(path, leaf):
+                    keys = tuple(str(k) for k in path)
+                    # + 1: the EMPTY suffix must be tried too — a
+                    # bare-leaf params tree has path (), and any state
+                    # leaf whose shape matches it is its moment
+                    for start in range(len(keys) + 1):
+                        hit = suffix.get(keys[start:])
+                        if hit and hit[1] == leaf.shape:
+                            return hit[0]
+                    return None
+
+                template = tree_map_with_path(_sh_for, struct)
+
+            def _sharding_spans(sh):
+                try:
+                    return (sh is not None
+                            and sh.device_set == mesh_devices)
+                except Exception:  # AbstractMesh shardings
+                    return False
+
+            def _place_like(x, ref_sh):
                 if _spans_mesh(x):
                     return x
-                target = (ref.sharding if _spans_mesh(ref)
+                target = (ref_sh if _sharding_spans(ref_sh)
                           else M.replicated(self.mesh))
                 return jax.device_put(np.asarray(x), target)
 
