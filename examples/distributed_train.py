@@ -32,7 +32,10 @@ def train_fn(ctx):
                                  axis=-1))
 
     rng = np.random.default_rng(0)
-    batch = 64 * ctx.size
+    # per-rank batch 64 is the benchmark shape; TPUDL_EXAMPLE_BATCH
+    # shrinks it for CPU smoke runs (ResNet50 at global batch 512 is
+    # minutes/step on a simulated CPU mesh)
+    batch = int(os.environ.get("TPUDL_EXAMPLE_BATCH", "64")) * ctx.size
 
     def data_fn(step):
         x = rng.integers(0, 256, size=(batch, 224, 224, 3), dtype=np.uint8)
@@ -40,7 +43,8 @@ def train_fn(ctx):
         return x, y
 
     trainer = ctx.trainer(loss_fn, optax.sgd(0.05))
-    params, _opt, hist = trainer.fit(params, data_fn, steps=20)
+    steps = int(os.environ.get("TPUDL_EXAMPLE_STEPS", "20"))
+    params, _opt, hist = trainer.fit(params, data_fn, steps=steps)
     return hist
 
 

@@ -24,13 +24,27 @@ def main(image_dir):
     frame = imageIO.readImages(image_dir).dropna()     # lazy, null-safe
     print(f"{len(frame)} decodable images")
 
-    feat = tpudl.DeepImageFeaturizer(
-        inputCol="image", outputCol="features",
-        modelName="InceptionV3",
-        weights="imagenet",        # offline artifact via $TPUDL_WEIGHTS_DIR
-        batchSize=256, computeDtype="bfloat16",
-        mesh=M.build_mesh())
-    out = feat.transform(frame)
+    def featurizer(weights):
+        return tpudl.DeepImageFeaturizer(
+            inputCol="image", outputCol="features",
+            modelName="InceptionV3",
+            weights=weights,       # offline artifact via $TPUDL_WEIGHTS_DIR
+            batchSize=256, computeDtype="bfloat16",
+            mesh=M.build_mesh())
+
+    # probe ONLY weight resolution — a transform failure (e.g. device
+    # OOM) must surface as itself, not as "weights unavailable"
+    from tpudl.ml.named_image import load_named_params
+
+    try:
+        load_named_params("InceptionV3", "imagenet")
+        weights = "imagenet"
+    except RuntimeError as e:  # no network, no $TPUDL_WEIGHTS_DIR artifact
+        print(f"pretrained weights unavailable ({e});\n"
+              "-- demo continues with RANDOM weights (features are real "
+              "shapes, not ImageNet semantics)")
+        weights = "random"
+    out = featurizer(weights).transform(frame)
     F = np.stack([np.asarray(v) for v in out["features"]])
     print("features:", F.shape, "mean", float(F.mean()))
 
@@ -40,4 +54,9 @@ def main(image_dir):
 
 
 if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        raise SystemExit(
+            f"usage: {sys.argv[0]} <image-directory>\n"
+            "(featurizes every image under the directory; set "
+            "TPUDL_WEIGHTS_DIR for pretrained weights)")
     main(sys.argv[1])
