@@ -11,8 +11,12 @@ judged matrix as sub-benches:
 - KerasTransformer tabular-MLP rows/sec (configs[4]),
 - KerasImageFileEstimator time-to-fit (configs[2]).
 
-Prints ONE JSON line; the headline featurize number is metric/value and
-the sub-bench numbers ride in the same object.
+Output contract (round-5 fix — the driver keeps only a ~2,000-char
+stdout TAIL, so the LAST line must be the judged record): stdout's
+final line is a COMPACT summary JSON (< 1,500 chars) with metric /
+value / unit / vs_baseline plus one scalar per sub-bench; the FULL
+record is written to ``bench_records/<name>.json`` (path echoed in the
+summary as ``full_record``) and to stderr.
 
 ``vs_baseline`` compares against the reference's execution substrate on
 this host — Keras/TF InceptionV3 inference on CPU (the reference
@@ -48,14 +52,104 @@ _EMITTED = threading.Event()
 _EMIT_LOCK = threading.Lock()
 
 
+def _scalar(v):
+    return v if isinstance(v, (int, float, str, bool, type(None))) else None
+
+
+def _compact_summary(record: dict) -> dict:
+    """The judged LAST-line record. The driver keeps only a ~2,000-char
+    stdout TAIL; round 4 emitted one large JSON line with the headline
+    keys FIRST, so the tail preserved the tail-end sub-benches and the
+    driver parsed nothing (BENCH_r04.json: parsed=null). This summary is
+    built to stay well under the tail window: headline keys + one scalar
+    per sub-bench, nothing nested deeper than one level."""
+    s = {k: record.get(k) for k in ("metric", "value", "unit",
+                                    "vs_baseline")}
+    for k in ("headline_mode", "compute_dtype", "batch_size",
+              "deadline_hit"):
+        if k in record:
+            s[k] = _scalar(record[k])
+    stream = record.get("featurize_streaming") or {}
+    if stream.get("trials") is not None:
+        s["streaming_trials"] = (stream.get("trials", [])
+                                 + stream.get("serial_trials", []))
+    sync = record.get("featurize_sync_mode") or {}
+    if sync.get("value") is not None:
+        s["sync_mode_value"] = sync["value"]
+    wire = record.get("wire_bandwidth") or {}
+    s["h2d_mb_per_sec"] = _scalar(wire.get("h2d_mb_per_sec"))
+    s["wire_bound_images_per_sec"] = _scalar(
+        record.get("wire_bound_images_per_sec"))
+    dev = record.get("device_profile") or {}
+    s["mfu_device"] = _scalar(dev.get("mfu_device"))
+    s["mfu_end_to_end"] = _scalar(record.get("mfu_end_to_end"))
+    s["compute_only_images_per_sec"] = _scalar(
+        record.get("compute_only_images_per_sec"))
+    s["tf_cpu_baseline_images_per_sec"] = _scalar(
+        record.get("tf_cpu_baseline_images_per_sec"))
+    for key, field in (("horovod_resnet50", "step_per_sec"),
+                       ("predictor_resnet50", "images_per_sec"),
+                       ("keras_transformer_mlp", "rows_per_sec"),
+                       ("estimator_inception", "step_per_sec"),
+                       ("decode", "native_images_per_sec")):
+        sub = record.get(key)
+        if isinstance(sub, dict):
+            # explicit None-chain: a present-but-0.0 primary field must
+            # NOT be silently replaced by a different metric
+            v = sub.get(field)
+            if v is None:
+                v = sub.get("value")
+            if v is None and key == "decode":
+                v = sub.get("pil_images_per_sec")
+            s[key] = _scalar(v)
+    if "full_record_path" in record:
+        s["full_record"] = record["full_record_path"]
+    return s
+
+
 def _emit(record: dict):
-    """Print the one judged JSON line exactly once (lock-guarded: the
-    watchdog thread and the main thread may race at the deadline)."""
+    """Emit the judged result exactly once (lock-guarded: the watchdog
+    thread and the main thread may race at the deadline).
+
+    Three sinks, in order:
+    1. the FULL record → ``bench_records/<name>.json`` (committed dir),
+    2. the full record → stderr (logs keep everything),
+    3. a compact summary (< 1,500 chars) as the LAST stdout line — the
+       only part the driver's stdout tail is guaranteed to keep."""
     with _EMIT_LOCK:
         if _EMITTED.is_set():
             return
         _EMITTED.set()
-    print(json.dumps(record), flush=True)
+    try:
+        rec_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_records")
+        os.makedirs(rec_dir, exist_ok=True)
+        # stable default so the driver's end-of-round run lands at the
+        # path the judge looks for (the driver commits leftover files)
+        name = os.environ.get("TPUDL_BENCH_RECORD_NAME", "BENCH_r05_full")
+        path = os.path.join(rec_dir, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(record, f, indent=1)
+        record["full_record_path"] = os.path.relpath(
+            path, os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:
+        log(f"full-record write failed: {e!r}")
+    try:
+        log("FULL RECORD: " + json.dumps(record, default=str))
+    except Exception as e:
+        log(f"full-record log failed: {e!r}")
+    # the last line must survive ANY per-sink failure above or a
+    # summary bug below — a raise here after the latch is set would
+    # reproduce the round-4 parsed=null failure permanently
+    try:
+        line = json.dumps(_compact_summary(record), default=str)
+    except Exception as e:
+        line = json.dumps(
+            {"metric": record.get("metric"), "value": record.get("value"),
+             "unit": record.get("unit"),
+             "vs_baseline": record.get("vs_baseline"),
+             "summary_error": repr(e)[:200]}, default=str)
+    print(line, flush=True)
 
 
 def _start_watchdog(record: dict):
@@ -921,25 +1015,22 @@ def measure_flash_attention():
         dense = jax.jit(lambda a, x, y: jnp.sum(
             attention_reference(a, x, y, causal=True)))
 
-        def timed(compiled):
-            float(compiled(q, k, v))  # warm (already compiled AOT)
-            vals = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                acc = jnp.zeros(())
-                for _ in range(reps):
-                    acc = acc + compiled(q, k, v)
-                float(acc)
-                vals.append((time.perf_counter() - t0) / reps)
-            return statistics.median(vals) * 1e3
+        def timed_once(compiled):
+            t0 = time.perf_counter()
+            acc = jnp.zeros(())
+            for _ in range(reps):
+                acc = acc + compiled(q, k, v)
+            float(acc)
+            return (time.perf_counter() - t0) / reps * 1e3
 
         entry = {"seq_len": s}
+        compiled = {}
         for kind, fn in (("flash", flash), ("dense", dense)):
             # ONE AOT compile serves both the memory record and the
             # timing (a second jit-path compile would double the rung's
             # compile cost at long S)
             try:
-                compiled = fn.lower(q, k, v).compile()
+                compiled[kind] = fn.lower(q, k, v).compile()
             except Exception as e:
                 entry[f"{kind}_error"] = repr(e)[:200]
                 continue
@@ -950,18 +1041,51 @@ def measure_flash_attention():
                 # the flash kernel's VMEM tiles do not. Recorded even
                 # when EXECUTION below fails — a dense OOM at long S is
                 # exactly when this number is the result.
-                ma = compiled.memory_analysis()
+                ma = compiled[kind].memory_analysis()
                 if ma:
                     entry[f"{kind}_temp_mb"] = round(
                         ma.temp_size_in_bytes / 2**20, 1)
             except Exception as e:
                 log(f"memory_analysis failed: {e!r}")
+        # Interleaved counterbalanced trials (round-4 verdict weak #3:
+        # single wall-clock values per rung couldn't distinguish "XLA
+        # got lucky" from "flash stops winning"). Each trial times both
+        # kernels back-to-back in alternating order; medians + the full
+        # trial lists land in the record, same pattern as the featurize
+        # bench.
+        trials = {"flash": [], "dense": []}
+        for kind in compiled:
             try:
-                entry[f"{kind}_ms"] = round(timed(compiled), 2)
+                float(compiled[kind](q, k, v))  # warm once
             except Exception as e:
-                # dense falling over at long S IS a result; keep it
-                # alongside the structural temp bytes above
                 entry[f"{kind}_error"] = repr(e)[:200]
+                compiled = {k2: c for k2, c in compiled.items()
+                            if k2 != kind}
+        for t in range(3):
+            order = (("flash", "dense") if t % 2 == 0
+                     else ("dense", "flash"))
+            for kind in order:
+                if kind not in compiled:
+                    continue
+                try:
+                    trials[kind].append(timed_once(compiled[kind]))
+                except Exception as e:
+                    # dense falling over at long S IS a result; keep it
+                    # alongside the structural temp bytes above
+                    entry[f"{kind}_error"] = repr(e)[:200]
+                    compiled.pop(kind, None)
+        for kind, ts in trials.items():
+            if not ts:
+                continue
+            if f"{kind}_error" in entry:
+                # failed mid-ladder: keep the partial evidence but do
+                # NOT present a median as a clean counterbalanced
+                # measurement (or feed it into speedup)
+                entry[f"{kind}_partial_trials_ms"] = [round(x, 2)
+                                                     for x in ts]
+                continue
+            entry[f"{kind}_ms"] = round(statistics.median(ts), 2)
+            entry[f"{kind}_trials_ms"] = [round(x, 2) for x in ts]
         if "flash_ms" in entry and "dense_ms" in entry:
             entry["speedup"] = round(entry["dense_ms"] / entry["flash_ms"],
                                      2)
@@ -1042,6 +1166,17 @@ def measure_healthy_channel_e2e(batch, dtype, n_batches=4):
             "enqueue_seconds": round(t_enq, 2),
             "blocked_seconds": round(t_blocked, 2),
             "n_images": n, "batch": batch}
+
+
+def _quiet_wire_probe(mb=8):
+    """8 MB H2D probe that returns None instead of raising — the
+    bracketing probes around sub-benches must never kill the sub-bench
+    they annotate."""
+    try:
+        return measure_wire_bandwidth(mb=mb)["h2d_mb_per_sec"]
+    except Exception as e:
+        log(f"wire probe failed: {e!r}")
+        return None
 
 
 def measure_wire_bandwidth(mb=64):
@@ -1226,6 +1361,12 @@ def main():
             log(f"device-profile sub-bench failed: {e!r}")
 
     if os.environ.get("TPUDL_BENCH_QUICK", "0") != "1":
+        # device-facing sub-benches get contemporaneous wire probes
+        # (round-4 verdict weak #2): an 8 MB H2D probe before and after,
+        # so round-over-round swings in these rows are attributable to
+        # tunnel weather INSIDE the same record
+        probed = {"horovod_resnet50", "predictor_resnet50",
+                  "estimator_inception"}
         for key, fn in [("horovod_resnet50", lambda: measure_train_step(dtype)),
                         ("predictor_resnet50", lambda: measure_predictor(dtype)),
                         ("keras_transformer_mlp", measure_keras_transformer),
@@ -1234,7 +1375,12 @@ def main():
                         ("decode", measure_decode),
                         ("flash_attention", measure_flash_attention)]:
             try:
-                extra[key] = fn()
+                pre = _quiet_wire_probe() if key in probed else None
+                rec = fn()
+                if key in probed and isinstance(rec, dict):
+                    rec["h2d_mb_per_sec_pre"] = pre
+                    rec["h2d_mb_per_sec_post"] = _quiet_wire_probe()
+                extra[key] = rec
             except Exception as e:  # sub-bench failure must not kill the bench
                 log(f"sub-bench {key} failed: {e!r}")
                 extra[key] = {"error": repr(e)}

@@ -33,7 +33,10 @@ def main(image_dir):
             mesh=M.build_mesh())
 
     # probe ONLY weight resolution — a transform failure (e.g. device
-    # OOM) must surface as itself, not as "weights unavailable"
+    # OOM) must surface as itself, not as "weights unavailable". The
+    # probe populates load_named_params' in-process cache, so the
+    # transformer's own resolution below is a dict hit, not a second
+    # download/disk read.
     from tpudl.ml.named_image import load_named_params
 
     try:

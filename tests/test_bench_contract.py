@@ -1,0 +1,138 @@
+"""The driver-facing bench output contract (round-5 fix).
+
+The driver stores only a ~2,000-char stdout TAIL of ``bench.py`` and
+parses its last line as the judged record. Round 4 emitted one large
+JSON line with the headline keys FIRST, so the tail held the cut-off
+END of the record and the driver parsed nothing (BENCH_r04.json:
+``parsed: null``). These tests pin the fixed contract against the REAL
+round-4 rehearsal record (committed at
+``bench_records/bench_r04_rehearsal.json``): the compact summary must
+carry the judged keys, fit comfortably inside the tail window, and be
+the LAST stdout line ``_emit`` prints.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def full_record():
+    path = os.path.join(REPO, "bench_records", "bench_r04_rehearsal.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_summary_fits_driver_tail(bench, full_record):
+    s = bench._compact_summary(full_record)
+    line = json.dumps(s)
+    # the driver tail is ~2,000 chars; the contract budgets 1,500 so a
+    # few trailing log lines can never push the summary out of it
+    assert len(line) < 1500, f"summary line is {len(line)} chars"
+    # nothing nested deeper than one list-of-scalars level
+    for v in s.values():
+        if isinstance(v, list):
+            assert all(isinstance(x, (int, float)) for x in v)
+        else:
+            assert isinstance(v, (int, float, str, bool, type(None)))
+
+
+def test_summary_carries_judged_keys(bench, full_record):
+    s = bench._compact_summary(full_record)
+    assert s["metric"] == full_record["metric"]
+    assert s["value"] == full_record["value"]
+    assert s["unit"] == full_record["unit"]
+    assert s["vs_baseline"] == full_record["vs_baseline"]
+    # the attribution fields the VERDICT asked for in the driver record
+    assert s["wire_bound_images_per_sec"] == \
+        full_record["wire_bound_images_per_sec"]
+    assert s["mfu_device"] == \
+        full_record["device_profile"]["mfu_device"]
+    assert s["streaming_trials"]  # per-trial evidence rides along
+    # sub-bench scalars present (field-name drift would break these)
+    assert s["horovod_resnet50"] == \
+        full_record["horovod_resnet50"]["step_per_sec"]
+    assert s["predictor_resnet50"] == \
+        full_record["predictor_resnet50"]["images_per_sec"]
+
+
+def test_summary_tolerates_partial_record(bench):
+    # the watchdog emits whatever was measured at the deadline: the
+    # summary must not KeyError on a near-empty record
+    s = bench._compact_summary({"metric": "m", "value": None,
+                                "unit": "u", "vs_baseline": None,
+                                "deadline_hit": True})
+    assert s["deadline_hit"] is True
+    assert s["value"] is None
+    assert len(json.dumps(s)) < 1500
+
+
+def test_emit_writes_full_record_and_prints_summary_last(
+        bench, full_record, monkeypatch):
+    monkeypatch.setenv("TPUDL_BENCH_RECORD_NAME", "contract_test")
+    # reset the once-only latch (module may be shared across tests)
+    bench._EMITTED.clear()
+    rec_path = os.path.join(REPO, "bench_records", "contract_test.json")
+    try:
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            bench._emit(dict(full_record))
+        lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+        last = json.loads(lines[-1])
+        assert last["value"] == full_record["value"]
+        assert len(lines[-1]) < 1500
+        assert os.path.join(REPO, last["full_record"]) == rec_path
+        with open(rec_path) as f:
+            stored = json.load(f)
+        assert stored["value"] == full_record["value"]
+        assert stored["featurize_streaming"]["interleaved_pairs"]
+        # second emit is a no-op (watchdog/main race discipline)
+        buf2 = io.StringIO()
+        with redirect_stdout(buf2):
+            bench._emit({"metric": "x", "value": 1, "unit": "u",
+                         "vs_baseline": None})
+        assert buf2.getvalue() == ""
+    finally:
+        # never leave a fake record for the driver's end-of-round
+        # commit to pick up (bench_records/ is a committed dir)
+        if os.path.exists(rec_path):
+            os.remove(rec_path)
+        bench._EMITTED.clear()
+
+
+def test_emit_summary_survives_unserializable_record(bench, monkeypatch,
+                                                     capsys):
+    """The latch is set before the sinks run: a record a sub-bench
+    polluted with a non-JSON value must still produce a parseable last
+    line (numpy scalars via default=str; worse objects via the
+    fallback summary)."""
+    monkeypatch.setenv("TPUDL_BENCH_RECORD_NAME", "contract_test2")
+    rec_path = os.path.join(REPO, "bench_records", "contract_test2.json")
+    bench._EMITTED.clear()
+    try:
+        bench._emit({"metric": "m", "value": 1.5, "unit": "u",
+                     "vs_baseline": None,
+                     "weird": object()})  # not JSON-serializable
+        out = capsys.readouterr().out.strip().splitlines()
+        last = json.loads(out[-1])
+        assert last["value"] == 1.5
+    finally:
+        if os.path.exists(rec_path):
+            os.remove(rec_path)
+        bench._EMITTED.clear()

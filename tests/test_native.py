@@ -194,3 +194,92 @@ class TestNativeImageLoader:
         b = np.stack(list(pil.transform(frame)["f"]))
         # decode+resize differ slightly (DCT downscale); features track
         assert np.abs(a - b).max() < 0.05, np.abs(a - b).max()
+
+
+def _encode(img: Image.Image, **save_kw) -> bytes:
+    buf = io.BytesIO()
+    img.save(buf, "JPEG", **save_kw)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def asym_photo():
+    """Deliberately orientation-revealing: a bright band along the top
+    row region, so any applied rotation changes the pixels."""
+    rng = np.random.default_rng(7)
+    arr = rng.integers(0, 96, size=(90, 120, 3), dtype=np.uint8)
+    arr[:12] = 230
+    return arr
+
+
+class TestRealWorldJpegMatrix:
+    """The exotic-variant matrix real datasets contain (round-4 verdict
+    item 7): progressive, EXIF-rotated, grayscale, CMYK. Fixtures are
+    deterministically generated (seeded array → PIL encoder flags), so
+    the repo carries no binary blobs but the decode matrix runs
+    everywhere. Each case asserts native/PIL agreement or the
+    documented, product-level-safe divergence."""
+
+    def test_progressive_bit_exact(self, asym_photo):
+        raw = _encode(Image.fromarray(asym_photo), quality=95,
+                      progressive=True)
+        assert Image.open(io.BytesIO(raw)).info.get("progressive"), \
+            "fixture is not actually progressive"
+        pil = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"))
+        batch, ok = native.decode_resize_batch([raw], 90, 120)
+        assert ok[0]
+        assert np.array_equal(batch[0][:, :, ::-1], pil)
+
+    def test_exif_orientation_is_metadata_both_paths(self, asym_photo):
+        """EXIF orientation is METADATA: neither the PIL product path
+        (Image.open().convert("RGB") — no exif_transpose) nor libjpeg
+        applies it; both decode the stored sensor orientation. This
+        pins that shared semantic — and that the tag would have
+        mattered (the transposed image differs), so the case isn't
+        vacuously symmetric."""
+        from PIL import ImageOps
+
+        exif = Image.Exif()
+        exif[274] = 6  # "rotate 90 CW to display"
+        raw = _encode(Image.fromarray(asym_photo), quality=95, exif=exif)
+        opened = Image.open(io.BytesIO(raw))
+        assert opened.getexif()[274] == 6
+        pil_raw = np.asarray(opened.convert("RGB"))
+        transposed = np.asarray(
+            ImageOps.exif_transpose(opened).convert("RGB"))
+        assert transposed.shape != pil_raw.shape  # tag is load-bearing
+        batch, ok = native.decode_resize_batch([raw], 90, 120)
+        assert ok[0]
+        assert np.array_equal(batch[0][:, :, ::-1], pil_raw)
+        struct = imageIO.default_decode(raw, origin="exif")
+        assert imageIO.imageStructToArray(struct).shape == (90, 120, 3)
+
+    def test_grayscale_widens_to_bgr_bit_exact(self, asym_photo):
+        raw = _encode(Image.fromarray(asym_photo).convert("L"), quality=95)
+        assert Image.open(io.BytesIO(raw)).mode == "L"
+        pil = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"))
+        batch, ok = native.decode_resize_batch([raw], 90, 120)
+        assert ok[0]
+        # JCS_RGB output replicates luma across all 3 channels exactly
+        # as PIL's L->RGB does (decode.cpp:97)
+        assert np.array_equal(batch[0][:, :, ::-1], pil)
+
+    def test_cmyk_documented_divergence_pil_fallback(self, asym_photo):
+        """CMYK JPEGs: libjpeg cannot emit JCS_RGB from a CMYK source,
+        so the native row fails CLEANLY (ok=False, zeroed row) and the
+        product path (imageIO.default_decode, keras_image batch_decode)
+        falls back to PIL, which handles the Adobe transform. The
+        divergence is per-row capability, never wrong pixels."""
+        raw = _encode(Image.fromarray(asym_photo).convert("CMYK"),
+                      quality=95)
+        assert Image.open(io.BytesIO(raw)).mode == "CMYK"
+        batch, ok = native.decode_resize_batch([raw], 90, 120)
+        assert not ok[0]
+        assert batch[0].sum() == 0  # null-row discipline, not garbage
+        # product level: the row is still decoded (via PIL), identical
+        # to the pure-PIL path
+        struct = imageIO.default_decode(raw, origin="cmyk")
+        pil_struct = imageIO.PIL_decode(raw, origin="cmyk")
+        assert struct is not None
+        assert np.array_equal(imageIO.imageStructToArray(struct),
+                              imageIO.imageStructToArray(pil_struct))

@@ -1,0 +1,97 @@
+"""Compiled-HLO pinning of the tensor-parallel zero-all-gather property.
+
+Round-4 review caught that constraining TP activations with ``None``
+(= replicated) in the PartitionSpec forced per-layer all-gathers of the
+DP-sharded activations; the fix was ``P.UNCONSTRAINED``
+(``tpudl/zoo/transformer.py`` tp_constrain). The loss-parity and
+still-sharded-shape assertions in ``__graft_entry__`` would NOT catch a
+regression that gathers and re-shards between ops — only the compiled
+program text shows it. These tests lower the real TP train step and
+assert the property on the HLO itself (round-4 verdict item 3).
+"""
+
+import re
+from collections import Counter
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudl import mesh as M
+from tpudl.train import make_train_step
+from tpudl.zoo.transformer import TinyCausalLM
+
+COLLECTIVES = ("all-gather", "all-reduce", "collective-permute",
+               "reduce-scatter", "all-to-all")
+
+
+def collective_counts(hlo_text: str) -> Counter:
+    pat = "|".join(re.escape(c) for c in COLLECTIVES)
+    return Counter(m.group(0)
+                   for m in re.finditer(rf"\b({pat})\b", hlo_text))
+
+
+@pytest.fixture(scope="module")
+def tp_step_hlo(mesh4x2):
+    lm = TinyCausalLM(vocab=32, dim=16, heads=2, layers=2)
+    params = lm.init(0)
+    shardings = lm.param_shardings(mesh4x2)
+    step = make_train_step(lm.loss_fn(mesh=mesh4x2, tp=True),
+                           optax.sgd(0.05), mesh=mesh4x2,
+                           param_shardings=shardings)
+    with M.use_mesh(mesh4x2):
+        p = lm.shard_params(params, mesh4x2)
+        opt = optax.sgd(0.05).init(p)
+        toks = M.shard_batch(
+            np.random.default_rng(0).integers(0, 32, size=(4, 9),
+                                              dtype=np.int32), mesh4x2)
+        return step.lower(p, opt, toks).compile().as_text()
+
+
+class TestTPZeroAllGather:
+    def test_no_all_gather_anywhere(self, tp_step_hlo):
+        """The pinned property: the whole TP train step — forward,
+        backward, optimizer update — compiles with ZERO all-gathers.
+        Params stay Megatron-sharded end to end; activations keep
+        their data-axis sharding through every tp_constrain. Dropping
+        the UNCONSTRAINED annotation reintroduces all-gathers (proven
+        by test_detector_sees_all_gather below), so this fails on that
+        regression."""
+        counts = collective_counts(tp_step_hlo)
+        assert counts["all-gather"] == 0, (
+            f"TP step compiled with all-gathers: {dict(counts)}")
+
+    def test_expected_collectives_present(self, tp_step_hlo):
+        """The step's communication is what the design says it is:
+        ppermute ring hops (SP attention) + all-reduces (the Megatron
+        row-parallel psums and the data-axis grad reduction). Their
+        PRESENCE pins that the program is genuinely distributed — a
+        vacuous pass (e.g. everything silently replicated on one
+        device) would have no collectives at all."""
+        counts = collective_counts(tp_step_hlo)
+        assert counts["collective-permute"] > 0, dict(counts)
+        assert counts["all-reduce"] > 0, dict(counts)
+
+    def test_detector_sees_all_gather(self, mesh4x2):
+        """Sensitivity control: the exact regression being pinned — a
+        replicated (None/P()) constraint on a data-sharded activation —
+        must produce an ``all-gather`` this file's detector can see. If
+        XLA ever renames the op in HLO text, this fails first, flagging
+        that test_no_all_gather_anywhere has gone vacuous."""
+
+        def f(x, w):
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh4x2, P(M.DATA_AXIS, None)))
+            h = x @ w
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh4x2, P()))  # the bug: replicated
+            return jnp.sum(h * h)
+
+        x = np.ones((8, 16), np.float32)
+        w = np.ones((16, 16), np.float32)
+        txt = jax.jit(jax.grad(f)).lower(x, w).compile().as_text()
+        assert collective_counts(txt)["all-gather"] > 0

@@ -52,6 +52,8 @@ def pipeline_blocks(block_fn, stacked_params, x_micro, mesh, *,
     """
     n = mesh.shape[axis]
     leaves = jax.tree.leaves(stacked_params)
+    if not leaves:
+        raise ValueError("stacked_params has no leaves")
     n_blocks = leaves[0].shape[0]
     if any(leaf.shape[0] != n_blocks for leaf in leaves):
         raise ValueError("stacked_params leaves disagree on block count")

@@ -205,9 +205,10 @@ class Trainer:
             # the placed params (so a caller-passed host state on the TP
             # path comes back model-SHARDED, not replicated — replicated
             # fp32 moments defeat the point of TP); everything else is
-            # replicated. A freshly-built state is its own template.
-            # sharding template WITHOUT materializing a second opt
-            # state. Zero-allocation routes that DON'T work (tried,
+            # replicated. A freshly-built state is its own template;
+            # otherwise the template is derived structurally from
+            # param_shardings WITHOUT materializing a second opt state.
+            # Zero-allocation routes that DON'T work (tried,
             # review-caught): eval_shape loses shardings entirely, and
             # AOT output_shardings of optimizer.init come back
             # replicated/single-device (XLA leaves trivial zeros_like

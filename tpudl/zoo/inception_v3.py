@@ -32,10 +32,53 @@ def _conv2d_bn(s: Store, x, filters, num_row, num_col, *, padding="SAME",
     return nn.relu(x)
 
 
+def _use_s2d_stem(s: Store, x) -> bool:
+    """Inference-apply only: init must CREATE the canonical params, and
+    train-mode BN computes per-channel batch stats that differ in the
+    4×-tiled s2d layout. Odd H/W is the InceptionV3 VALID geometry the
+    transform is derived for.
+
+    Default OFF: measured 40.83 ms/step vs the canonical stem's
+    34.26 ms on the real v5e chip (PROFILE.md "space-to-depth" section
+    — the s2d reshuffles cost ~4.4 ms of HBM copies and XLA's conv
+    already contracts over kh·kw·ci, so 3×3×32 = 288 taps was never
+    lane-starved). Kept because the transform is exact and tested; a
+    future backend where skinny convs DO underfill can flip it on."""
+    import os
+
+    return (not s.initializing and not s.train
+            and os.environ.get("TPUDL_S2D_STEM", "0") == "1"
+            and x.shape[1] % 2 == 1 and x.shape[2] % 2 == 1
+            and x.shape[1] >= 7 and x.shape[2] >= 7)
+
+
+def _stem_s2d(s: Store, x):
+    """The three stem conv+BN+ReLU layers in space-to-depth form
+    (tpudl.zoo.s2d — measured SLOWER than the canonical stem on v5e;
+    see _use_s2d_stem above and PROFILE.md). Reads the SAME
+    canonically-named params the plain stem uses, advancing the Namer
+    identically, so checkpoints/conversion are unaffected."""
+    from tpudl.zoo.s2d import inception_stem_s2d
+
+    pairs = [(s.name("conv2d"), s.name("batch_normalization"))
+             for _ in range(3)]
+    (c1, b1), (c2, b2), (c3, b3) = pairs
+
+    def bn_apply(t, p):
+        return nn.batch_norm(t, p, train=False, epsilon=1e-3)
+
+    return inception_stem_s2d(
+        x, s.params[c1], s.params[b1], s.params[c2], s.params[b2],
+        s.params[c3], s.params[b3], bn_apply=bn_apply, relu=nn.relu)
+
+
 def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
-    x = _conv2d_bn(s, x, 32, 3, 3, strides=(2, 2), padding="VALID")
-    x = _conv2d_bn(s, x, 32, 3, 3, padding="VALID")
-    x = _conv2d_bn(s, x, 64, 3, 3)
+    if _use_s2d_stem(s, x):
+        x = _stem_s2d(s, x)
+    else:
+        x = _conv2d_bn(s, x, 32, 3, 3, strides=(2, 2), padding="VALID")
+        x = _conv2d_bn(s, x, 32, 3, 3, padding="VALID")
+        x = _conv2d_bn(s, x, 64, 3, 3)
     x = nn.max_pool(x, (3, 3), strides=(2, 2))
 
     x = _conv2d_bn(s, x, 80, 1, 1, padding="VALID")
