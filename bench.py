@@ -129,7 +129,7 @@ def _emit(record: dict):
         name = os.environ.get("TPUDL_BENCH_RECORD_NAME", "BENCH_r05_full")
         path = os.path.join(rec_dir, f"{name}.json")
         with open(path, "w") as f:
-            json.dump(record, f, indent=1)
+            json.dump(record, f, indent=1, default=str)
         record["full_record_path"] = os.path.relpath(
             path, os.path.dirname(os.path.abspath(__file__)))
     except Exception as e:

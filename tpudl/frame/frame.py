@@ -225,6 +225,16 @@ class Frame:
              for k, v in self._cols.items()},
             self.num_partitions)
 
+    def take(self, indices) -> "Frame":
+        """Rows by integer index, in the GIVEN order (duplicates
+        allowed) — the ORDER BY backbone; filter_rows is the boolean
+        sibling."""
+        idx = np.asarray(indices, dtype=int)
+        return Frame(
+            {k: (v.subset(idx) if isinstance(v, LazyColumn) else v[idx])
+             for k, v in self._cols.items()},
+            self.num_partitions)
+
     def dropna(self, subset: Sequence[str] | None = None) -> "Frame":
         """Drop rows with None/NaN in ``subset`` (default: all columns).
         On a LazyColumn nullness comes from the column's cheap

