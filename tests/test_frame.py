@@ -444,3 +444,32 @@ class TestSqlAnalytics:
         assert list(out["name"]) == ["apple", "fig", "pear"]
         out = sql("SELECT name FROM t ORDER BY name DESC", {"t": t})
         assert list(out["name"]) == ["pear", "fig", "apple"]
+
+    def test_order_by_with_real_infinities_nulls_still_last(self):
+        """Review-caught: ±inf column values must keep their sort
+        positions while NULL/NaN rows land last in BOTH directions
+        (an inf sentinel for nulls would interleave them)."""
+        from tpudl.frame import sql
+
+        t = Frame({"x": np.array([np.nan, np.inf, 1.0, -np.inf])})
+        asc = sql("SELECT x FROM t ORDER BY x", {"t": t})["x"]
+        np.testing.assert_array_equal(asc[:3], [-np.inf, 1.0, np.inf])
+        assert np.isnan(asc[3])
+        desc = sql("SELECT x FROM t ORDER BY x DESC", {"t": t})["x"]
+        np.testing.assert_array_equal(desc[:3], [np.inf, 1.0, -np.inf])
+        assert np.isnan(desc[3])
+
+    def test_clause_keywords_inside_string_literal(self):
+        """Review-caught: 'a order by b' in a WHERE literal must not
+        terminate the WHERE clause (quote-aware clause splitting)."""
+        from tpudl.frame import sql
+
+        t = Frame({"cls": np.array(["a order by b", "group by",
+                                    "limit 3", "plain"], dtype=object)})
+        assert list(sql("SELECT cls FROM t WHERE cls = 'a order by b'",
+                        {"t": t})["cls"]) == ["a order by b"]
+        assert list(sql("SELECT cls FROM t WHERE cls = 'group by'",
+                        {"t": t})["cls"]) == ["group by"]
+        out = sql("SELECT cls FROM t WHERE cls = 'limit 3' LIMIT 1",
+                  {"t": t})
+        assert list(out["cls"]) == ["limit 3"]

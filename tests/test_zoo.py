@@ -23,7 +23,8 @@ keras = pytest.importorskip("keras")
 
 # smallest legal input per architecture (keeps the CPU oracle fast)
 _SMALL = {"InceptionV3": 75, "Xception": 71, "ResNet50": 32, "VGG16": 32,
-          "VGG19": 32, "MobileNetV2": 32, "DenseNet121": 32}
+          "VGG19": 32, "MobileNetV2": 32, "DenseNet121": 32,
+          "ResNet101": 32, "ResNet152": 32}
 
 
 @pytest.fixture(scope="module")

@@ -45,12 +45,16 @@ from tpudl.frame.frame import Frame, null_mask
 
 __all__ = ["sql"]
 
+# position-is-outside-quotes guard (even number of quotes remaining) —
+# the same trick _AND_SPLIT_RE uses, so clause keywords inside WHERE
+# string literals ('a order by b') never terminate the WHERE group
+_Q = r"(?=(?:[^']*'[^']*')*[^']*$)"
 _SELECT_RE = re.compile(
     r"^\s*select\s+(?P<items>.+?)\s+from\s+(?P<table>\w+)"
-    r"(?:\s+where\s+(?P<where>.+?))?"
-    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
-    r"(?:\s+order\s+by\s+(?P<order>.+?))?"
-    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    rf"(?:\s+where\s+{_Q}(?P<where>.+?))?"
+    rf"(?:\s+group\s+by\s+{_Q}(?P<group>.+?))?"
+    rf"(?:\s+order\s+by\s+{_Q}(?P<order>.+?))?"
+    rf"(?:\s+limit\s+{_Q}(?P<limit>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
 _ITEM_RE = re.compile(
@@ -171,9 +175,6 @@ def _aggregate(frame: Frame, items, group_cols: list[str]) -> Frame:
             raise ValueError(
                 f"column {spec!r} must appear in GROUP BY or inside an "
                 "aggregate")
-    for g in group_cols:
-        _col(frame, g)  # raise on unknown before grouping
-
     # group keys → row indices, first-appearance order; NULL/NaN keys
     # normalize to one sentinel so they form a single group
     if group_cols:
@@ -260,10 +261,12 @@ def _order_perm(frame: Frame, order: str) -> np.ndarray:
             idx = np.asarray(keyed, dtype=int)
         else:
             vals = col.astype(float, copy=True)
-            # NULL/NaN always sorts last: +inf under ascending sort,
-            # -inf under the negated (descending) sort
-            vals[nulls] = -np.inf if desc else np.inf
-            idx = np.argsort(-vals if desc else vals, kind="stable")
+            # two-key stable sort, null flag primary: real ±inf values
+            # keep their order and NULL/NaN rows still land last (a
+            # ±inf SENTINEL for nulls would interleave them with real
+            # infinities)
+            vals[nulls] = 0.0
+            idx = np.lexsort((-vals if desc else vals, nulls))
         perm = perm[idx]
     return perm
 

@@ -58,6 +58,8 @@ class NamedModel:
             "VGG19": "vgg19",
             "MobileNetV2": "mobilenet_v2",
             "DenseNet121": "densenet",
+            "ResNet101": "resnet",
+            "ResNet152": "resnet",
         }[self.name]
 
     @property
@@ -155,6 +157,8 @@ class NamedModel:
             "VGG19": keras.applications.VGG19,
             "MobileNetV2": keras.applications.MobileNetV2,
             "DenseNet121": keras.applications.DenseNet121,
+            "ResNet101": keras.applications.ResNet101,
+            "ResNet152": keras.applications.ResNet152,
         }[self.name]
 
 
@@ -177,6 +181,10 @@ SUPPORTED_MODELS: dict[str, NamedModel] = {
                    mobilenet_v2.PREPROCESS_MODE),
         NamedModel("DenseNet121", densenet.build, densenet.INPUT_SIZE,
                    densenet.FEATURE_DIM, densenet.PREPROCESS_MODE),
+        NamedModel("ResNet101", resnet.build_resnet101, resnet.INPUT_SIZE,
+                   resnet.FEATURE_DIM, resnet.PREPROCESS_MODE),
+        NamedModel("ResNet152", resnet.build_resnet152, resnet.INPUT_SIZE,
+                   resnet.FEATURE_DIM, resnet.PREPROCESS_MODE),
     ]
 }
 

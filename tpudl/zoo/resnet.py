@@ -54,7 +54,11 @@ def _stack(s: Store, x, filters, blocks, *, stride1=2, name=""):
     return x
 
 
-def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+def _build_resnet(s: Store, x, stacks, *, include_top=True, pooling=None,
+                  classes=1000):
+    """Shared v1 bottleneck skeleton; ``stacks`` = blocks per
+    conv2..conv5 stage (keras.applications.resnet: ResNet50 (3,4,6,3),
+    ResNet101 (3,4,23,3), ResNet152 (3,8,36,3))."""
     x = nn.zero_pad(x, ((3, 3), (3, 3)))
     x = s.conv(x, 64, 7, strides=(2, 2), padding="VALID", name="conv1_conv")
     x = s.bn(x, epsilon=_EPS, name="conv1_bn")
@@ -62,10 +66,9 @@ def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
     x = nn.zero_pad(x, ((1, 1), (1, 1)))
     x = nn.max_pool(x, (3, 3), strides=(2, 2))
 
-    x = _stack(s, x, 64, 3, stride1=1, name="conv2")
-    x = _stack(s, x, 128, 4, name="conv3")
-    x = _stack(s, x, 256, 6, name="conv4")
-    x = _stack(s, x, 512, 3, name="conv5")
+    for i, (filters, blocks) in enumerate(zip((64, 128, 256, 512), stacks)):
+        x = _stack(s, x, filters, blocks, stride1=1 if i == 0 else 2,
+                   name=f"conv{i + 2}")
 
     if include_top:
         x = nn.global_avg_pool(x)
@@ -76,3 +79,22 @@ def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
     if pooling == "max":
         return nn.global_max_pool(x)
     return x
+
+
+def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    return _build_resnet(s, x, (3, 4, 6, 3), include_top=include_top,
+                         pooling=pooling, classes=classes)
+
+
+def build_resnet101(s: Store, x, *, include_top=True, pooling=None,
+                    classes=1000):
+    """keras.applications.resnet.ResNet101: stacks (3, 4, 23, 3)."""
+    return _build_resnet(s, x, (3, 4, 23, 3), include_top=include_top,
+                         pooling=pooling, classes=classes)
+
+
+def build_resnet152(s: Store, x, *, include_top=True, pooling=None,
+                    classes=1000):
+    """keras.applications.resnet.ResNet152: stacks (3, 8, 36, 3)."""
+    return _build_resnet(s, x, (3, 8, 36, 3), include_top=include_top,
+                         pooling=pooling, classes=classes)
