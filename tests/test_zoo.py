@@ -24,7 +24,7 @@ keras = pytest.importorskip("keras")
 # smallest legal input per architecture (keeps the CPU oracle fast)
 _SMALL = {"InceptionV3": 75, "Xception": 71, "ResNet50": 32, "VGG16": 32,
           "VGG19": 32, "MobileNetV2": 32, "DenseNet121": 32,
-          "ResNet101": 32, "ResNet152": 32}
+          "ResNet101": 32, "ResNet152": 32, "EfficientNetB0": 32}
 
 
 @pytest.fixture(scope="module")
@@ -141,3 +141,42 @@ def test_train_mode_returns_bn_updates(rng):
     # moving stats must actually move
     assert not np.allclose(np.asarray(updates[lname]["moving_mean"]),
                            np.asarray(params[lname]["moving_mean"]))
+
+
+def test_normalization_rescaling_fold(rng):
+    """convert.params_from_keras folds a per-channel Rescaling that
+    directly follows a weighted Normalization into its variance (the
+    keras EfficientNet imagenet-graph workaround), and ONLY then: an
+    intervening weighted layer or nonzero offset must leave params
+    untouched."""
+    import keras
+
+    def build(with_rescale, intervene=False):
+        x = inp = keras.Input((8, 8, 3))
+        # no explicit mean/variance: that path stores them as weights,
+        # exactly how keras EfficientNet's normalization layer is built
+        norm = keras.layers.Normalization(axis=-1)
+        x = norm(x)
+        if intervene:
+            x = keras.layers.Conv2D(3, 1, use_bias=False)(x)
+        if with_rescale:
+            x = keras.layers.Rescaling([0.5, 0.5, 0.5])(x)
+        x = keras.layers.Conv2D(2, 1)(x)
+        model = keras.Model(inp, x)
+        norm.set_weights([np.array([1.0, 2.0, 3.0], np.float32),
+                          np.array([4.0, 4.0, 4.0], np.float32),
+                          np.array(1, np.int64)])
+        return model
+
+    from tpudl.zoo.convert import params_from_keras
+
+    plain = params_from_keras(build(False))
+    np.testing.assert_allclose(plain["normalization"]["variance"],
+                               [4.0, 4.0, 4.0])
+    folded = params_from_keras(build(True))
+    # (x-m)/sqrt(v) * 0.5 == (x-m)/sqrt(v/0.25) → variance 16
+    np.testing.assert_allclose(folded["normalization"]["variance"],
+                               [16.0, 16.0, 16.0])
+    untouched = params_from_keras(build(True, intervene=True))
+    np.testing.assert_allclose(untouched["normalization"]["variance"],
+                               [4.0, 4.0, 4.0])

@@ -28,6 +28,7 @@ _BASE_NAMES = {
     "SeparableConv2D": "separable_conv2d",
     "DepthwiseConv2D": "depthwise_conv2d",
     "BatchNormalization": "batch_normalization",
+    "Normalization": "normalization",
     "Dense": "dense",
 }
 
@@ -66,11 +67,32 @@ def params_from_keras(model) -> dict:
     layer names (creation-order renumbering, see _canonical_names)."""
     params: dict[str, dict] = {}
     names = _canonical_names(model)
+    last_norm = None
     for layer in model.layers:
         cls = type(layer).__name__
+        if cls == "Rescaling" and last_norm is not None and \
+                np.ndim(layer.scale) > 0 and \
+                not np.any(np.asarray(layer.offset)):
+            # keras EfficientNet's imagenet graph appends an extra
+            # per-channel Rescaling(1/sqrt(stddev)) AFTER the weighted
+            # Normalization layer (keras efficientnet.py, the
+            # tf#49930 workaround). (x-m)/sqrt(v) * s == (x-m)/sqrt(v/s²),
+            # so fold it into the stored variance — the build fn then
+            # has ONE normalization spelling for random and pretrained.
+            params[last_norm]["variance"] = (
+                params[last_norm]["variance"]
+                / np.square(np.asarray(layer.scale, dtype=np.float64))
+            ).astype(params[last_norm]["variance"].dtype)
+            last_norm = None  # fold at most once, only right after
+            continue
         if cls not in _BASE_NAMES or not layer.weights:
             continue
         name = names[layer.name]
+        # a fold is only valid while Normalization is the most recent
+        # weighted layer (any other weighted layer in between means the
+        # Rescaling does not belong to it)
+        if cls != "Normalization":
+            last_norm = None
         if cls == "Conv2D":
             p = {"kernel": np.asarray(layer.kernel)}
             if layer.use_bias:
@@ -94,6 +116,10 @@ def params_from_keras(model) -> dict:
                 p["beta"] = np.asarray(layer.beta)
             if layer.scale:
                 p["gamma"] = np.asarray(layer.gamma)
+        elif cls == "Normalization":
+            w = layer.get_weights()  # [mean, variance(, count)]
+            p = {"mean": np.asarray(w[0]), "variance": np.asarray(w[1])}
+            last_norm = name
         elif cls == "Dense":
             p = {"kernel": np.asarray(layer.kernel)}
             if layer.use_bias:

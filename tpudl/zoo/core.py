@@ -191,6 +191,24 @@ class Store:
             return y
         return nn.batch_norm(x, p, train=False, epsilon=epsilon)
 
+    def norm_stats(self, x, *, name=None):
+        """Keras ``Normalization`` layer: (x - mean) / sqrt(variance)
+        with mean/variance as (non-trainable) WEIGHTS — EfficientNet
+        normalizes inside the model this way. Fresh init is the
+        identity (mean 0, variance 1), matching a weights=None keras
+        build; pretrained stats arrive via conversion (which also folds
+        the imagenet graph's extra 1/sqrt(stddev) rescale into the
+        variance — convert.params_from_keras)."""
+        lname = self.name("normalization", name)
+        c = x.shape[-1]
+
+        def make():
+            return {"mean": self._zeros((c,)), "variance": self._ones((c,))}
+
+        p = self._get(lname, make)
+        return ((x - jnp.asarray(p["mean"], x.dtype))
+                / jnp.sqrt(jnp.asarray(p["variance"], x.dtype)))
+
     def dense(self, x, units, *, use_bias=True, name=None):
         lname = self.name("dense", name)
         cin = x.shape[-1]

@@ -1,0 +1,139 @@
+"""EfficientNetB0 as a pure JAX build function.
+
+Architecture follows keras.applications.efficientnet.EfficientNetB0
+exactly (stable semantic layer names: stem_conv, block{i}{a..}_dwconv,
+..., top_conv), extending the zoo beyond the reference registry the
+same way MobileNetV2/DenseNet121 did. Reference consumer: sparkdl
+transformers/keras_applications.py registry pattern (~L30-200) — the
+reference stops at five models; EfficientNet is the transfer-learning
+default the years since have produced, so a migrating user gets it
+under the same DeepImageFeaturizer surface. 224×224 input, identity
+("raw") preprocessing — the model normalizes INTERNALLY via
+Rescaling(1/255) + a Normalization layer whose mean/variance are
+weights (converted like any other layer; the pretrained graph's extra
+1/sqrt(stddev) Rescaling is folded into the variance at conversion,
+see convert.params_from_keras).
+
+Keras-source details mirrored here: BN epsilon defaults (1e-3), swish
+activations, SE squeeze-excite with ratio 0.25 on every MBConv block,
+stride-2 blocks use ZeroPadding2D(correct_pad) + VALID depthwise,
+project conv has NO activation, residual add only when stride 1 and
+filters_in == filters_out. B0 coefficients (width 1.0 / depth 1.0)
+leave the block table as-is; the divisor-8 filter rounding is the
+identity on it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.zoo import nn
+from tpudl.zoo.core import Store
+
+NAME = "EfficientNetB0"
+INPUT_SIZE = (224, 224)
+FEATURE_DIM = 1280
+PREPROCESS_MODE = "raw"
+
+# keras DEFAULT_BLOCKS_ARGS (B0: width/depth coefficients 1.0, so
+# round_filters/round_repeats are the identity on this table)
+_BLOCKS = [
+    # kernel, repeats, filters_in, filters_out, expand, strides
+    (3, 1, 32, 16, 1, 1),
+    (3, 2, 16, 24, 6, 2),
+    (5, 2, 24, 40, 6, 2),
+    (3, 3, 40, 80, 6, 2),
+    (5, 3, 80, 112, 6, 1),
+    (5, 4, 112, 192, 6, 2),
+    (3, 1, 192, 320, 6, 1),
+]
+_SE_RATIO = 0.25
+
+
+def _swish(x):
+    return jax.nn.silu(x)
+
+
+def _correct_pad(x, kernel):
+    """keras imagenet_utils.correct_pad: asymmetric zero-pad so a
+    stride-2 VALID conv lands on the same grid as 'same' would."""
+    h, w = int(x.shape[1]), int(x.shape[2])
+    c = kernel // 2
+    adj = (1 - h % 2, 1 - w % 2)
+    return ((c - adj[0], c), (c - adj[1], c))
+
+
+def _conv_bn_act(s: Store, x, filters, kernel, *, strides=1, name,
+                 act=True):
+    x = s.conv(x, filters, kernel, strides=(strides, strides),
+               padding="SAME", use_bias=False, name=f"{name}_conv")
+    x = s.bn(x, name=f"{name}_bn")
+    return _swish(x) if act else x
+
+
+def _block(s: Store, x, kernel, filters_in, filters_out, expand, stride,
+           name):
+    filters = filters_in * expand
+    if expand != 1:
+        h = s.conv(x, filters, 1, padding="SAME", use_bias=False,
+                   name=f"{name}_expand_conv")
+        h = _swish(s.bn(h, name=f"{name}_expand_bn"))
+    else:
+        h = x
+    if stride == 2:
+        h = nn.zero_pad(h, _correct_pad(h, kernel))
+        pad = "VALID"
+    else:
+        pad = "SAME"
+    h = s.depthwise_conv(h, kernel, strides=(stride, stride), padding=pad,
+                         use_bias=False, name=f"{name}_dwconv")
+    h = _swish(s.bn(h, name=f"{name}_bn"))
+
+    # squeeze-excite: global-average over space → two 1×1 convs
+    # (swish bottleneck of filters_in/4, sigmoid gate) → rescale
+    se = jnp.mean(h, axis=(1, 2), keepdims=True)
+    se = s.conv(se, max(1, int(filters_in * _SE_RATIO)), 1,
+                padding="SAME", name=f"{name}_se_reduce")
+    se = _swish(se)
+    se = s.conv(se, filters, 1, padding="SAME", name=f"{name}_se_expand")
+    h = h * jax.nn.sigmoid(se)
+
+    h = s.conv(h, filters_out, 1, padding="SAME", use_bias=False,
+               name=f"{name}_project_conv")
+    h = s.bn(h, name=f"{name}_project_bn")  # no activation (keras)
+    if stride == 1 and filters_in == filters_out:
+        h = h + x  # dropout before the add is inference-identity
+    return h
+
+
+def build(s: Store, x, *, include_top=True, pooling=None, classes=1000):
+    # internal preprocessing: Rescaling(1/255) then the weighted
+    # Normalization layer — (x - mean)/sqrt(variance), per keras
+    x = x / 255.0
+    x = s.norm_stats(x)
+
+    x = nn.zero_pad(x, _correct_pad(x, 3))
+    x = s.conv(x, 32, 3, strides=(2, 2), padding="VALID", use_bias=False,
+               name="stem_conv")
+    x = _swish(s.bn(x, name="stem_bn"))
+
+    for i, (kernel, repeats, f_in, f_out, expand, stride) in enumerate(
+            _BLOCKS):
+        for j in range(repeats):
+            x = _block(s, x, kernel,
+                       f_in if j == 0 else f_out, f_out, expand,
+                       stride if j == 0 else 1,
+                       name=f"block{i + 1}{chr(97 + j)}")
+
+    x = _conv_bn_act(s, x, 1280, 1, name="top")
+
+    if include_top:
+        x = nn.global_avg_pool(x)
+        x = s.dense(x, classes, name="predictions")
+        return nn.softmax(x)
+    if pooling == "avg":
+        return nn.global_avg_pool(x)
+    if pooling == "max":
+        return nn.global_max_pool(x)
+    return x

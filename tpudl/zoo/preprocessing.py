@@ -7,8 +7,10 @@ named_image.py ~L120). These are the classic silent-mismatch spots
 (SURVEY.md §7.3 hard part #1), so modes are implemented explicitly:
 
 - ``tf``    : x/127.5 - 1, RGB input            (InceptionV3, Xception)
-- ``caffe`` : RGB→BGR, subtract ImageNet means  (ResNet50, VGG16, VGG19)
+- ``caffe`` : RGB→BGR, subtract ImageNet means  (ResNet50/101/152, VGG)
 - ``torch`` : x/255 then per-channel mean/std   (DenseNet121)
+- ``raw``   : identity — normalization lives INSIDE the model as a
+  weighted layer                                 (EfficientNetB0)
 
 All fns are jittable and assume float input in [0, 255] **RGB** channel
 order (convert from BGR storage first via tpudl.image.ops).
@@ -31,6 +33,10 @@ _TORCH_STD = (0.229, 0.224, 0.225)
 
 def preprocess_input(x, mode: str = "caffe"):
     """x: (..., H, W, 3) float, RGB, values in [0, 255]."""
+    if mode == "raw":
+        # EfficientNet: keras preprocess_input is a pass-through — the
+        # model rescales/normalizes internally (weighted Normalization)
+        return x
     if mode == "tf":
         return x / 127.5 - 1.0
     if mode == "caffe":

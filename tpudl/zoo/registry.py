@@ -18,8 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpudl.zoo import (densenet, inception_v3, mobilenet_v2, resnet, vgg,
-                       xception)
+from tpudl.zoo import (densenet, efficientnet, inception_v3, mobilenet_v2,
+                       resnet, vgg, xception)
 from tpudl.zoo.core import Store
 from tpudl.zoo.preprocessing import preprocess_input
 
@@ -60,6 +60,7 @@ class NamedModel:
             "DenseNet121": "densenet",
             "ResNet101": "resnet",
             "ResNet152": "resnet",
+            "EfficientNetB0": "efficientnet",
         }[self.name]
 
     @property
@@ -159,6 +160,7 @@ class NamedModel:
             "DenseNet121": keras.applications.DenseNet121,
             "ResNet101": keras.applications.ResNet101,
             "ResNet152": keras.applications.ResNet152,
+            "EfficientNetB0": keras.applications.EfficientNetB0,
         }[self.name]
 
 
@@ -185,6 +187,9 @@ SUPPORTED_MODELS: dict[str, NamedModel] = {
                    resnet.FEATURE_DIM, resnet.PREPROCESS_MODE),
         NamedModel("ResNet152", resnet.build_resnet152, resnet.INPUT_SIZE,
                    resnet.FEATURE_DIM, resnet.PREPROCESS_MODE),
+        NamedModel("EfficientNetB0", efficientnet.build,
+                   efficientnet.INPUT_SIZE, efficientnet.FEATURE_DIM,
+                   efficientnet.PREPROCESS_MODE),
     ]
 }
 
