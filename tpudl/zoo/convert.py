@@ -70,20 +70,24 @@ def params_from_keras(model) -> dict:
     last_norm = None
     for layer in model.layers:
         cls = type(layer).__name__
-        if cls == "Rescaling" and last_norm is not None and \
-                np.ndim(layer.scale) > 0 and \
-                not np.any(np.asarray(layer.offset)):
-            # keras EfficientNet's imagenet graph appends an extra
-            # per-channel Rescaling(1/sqrt(stddev)) AFTER the weighted
-            # Normalization layer (keras efficientnet.py, the
-            # tf#49930 workaround). (x-m)/sqrt(v) * s == (x-m)/sqrt(v/s²),
-            # so fold it into the stored variance — the build fn then
-            # has ONE normalization spelling for random and pretrained.
-            params[last_norm]["variance"] = (
-                params[last_norm]["variance"]
-                / np.square(np.asarray(layer.scale, dtype=np.float64))
-            ).astype(params[last_norm]["variance"].dtype)
-            last_norm = None  # fold at most once, only right after
+        if cls == "Rescaling":
+            if last_norm is not None and np.ndim(layer.scale) > 0 and \
+                    not np.any(np.asarray(layer.offset)):
+                # keras EfficientNet's imagenet graph appends an extra
+                # per-channel Rescaling(1/sqrt(stddev)) AFTER the
+                # weighted Normalization layer (keras efficientnet.py,
+                # the tf#49930 workaround). (x-m)/sqrt(v) * s ==
+                # (x-m)/sqrt(v/s²), so fold it into the stored variance
+                # — the build fn then has ONE normalization spelling
+                # for random and pretrained.
+                params[last_norm]["variance"] = (
+                    params[last_norm]["variance"]
+                    / np.square(np.asarray(layer.scale, dtype=np.float64))
+                ).astype(params[last_norm]["variance"].dtype)
+            # ANY Rescaling ends the fold window: a non-qualifying one
+            # (scalar scale / nonzero offset) between the Normalization
+            # and a later per-channel Rescaling breaks the algebra
+            last_norm = None
             continue
         if cls not in _BASE_NAMES or not layer.weights:
             continue

@@ -206,8 +206,11 @@ class Store:
             return {"mean": self._zeros((c,)), "variance": self._ones((c,))}
 
         p = self._get(lname, make)
+        # keras Normalization clamps: maximum(sqrt(var), epsilon) — a
+        # zero-variance channel must match the oracle, not produce inf
         return ((x - jnp.asarray(p["mean"], x.dtype))
-                / jnp.sqrt(jnp.asarray(p["variance"], x.dtype)))
+                / jnp.maximum(jnp.sqrt(jnp.asarray(p["variance"],
+                                                   x.dtype)), 1e-7))
 
     def dense(self, x, units, *, use_bias=True, name=None):
         lname = self.name("dense", name)

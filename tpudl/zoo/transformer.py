@@ -71,6 +71,9 @@ class TinyCausalLM:
         # dispatch/combine collectives (the GShard pattern)
         self.experts = experts
         self.capacity_factor = capacity_factor
+        # compiled generate() programs keyed by static decode geometry
+        # (a fresh jax.jit per call would retrace every time)
+        self._gen_jits: dict = {}
 
     # -- params -----------------------------------------------------------
     def init(self, seed: int = 0) -> dict:
@@ -371,6 +374,136 @@ class TinyCausalLM:
               + p["b_down_e"][:, None, None, :])
         ye = tp_constrain(ye, (head_axis, None, None, None))
         return jnp.einsum("bsec,ebcd->bsd", combine, ye).astype(h.dtype)
+
+    # -- autoregressive decode (KV cache) ----------------------------------
+    def init_cache(self, batch: int, max_len: int | None = None,
+                   dtype=jnp.float32):
+        """Per-layer K/V buffers for incremental decoding:
+        ``[B, max_len, heads, head_dim]`` zeros. Static shapes — the
+        decode loop writes position ``pos`` via dynamic_update_slice,
+        so the whole generate() scan compiles once (no growing
+        sequences under jit, the TPU-native spelling of a KV cache)."""
+        L = max_len or self.max_len
+        dh = self.dim // self.heads
+        buf = jnp.zeros((batch, L, self.heads, dh), dtype)
+        return [{"k": buf, "v": buf} for _ in range(self.layers)]
+
+    def decode_step(self, params, tok, cache, pos):
+        """One incremental step: token ids ``tok`` [B] at position
+        ``pos`` (traced scalar) → (logits [B, vocab], updated cache).
+
+        Same block math as :meth:`apply` (oracle-pinned in
+        tests/test_transformer.py) but attention reads the K/V CACHE:
+        scores over positions 0..pos only (mask on a static length),
+        new K/V written at ``pos``. MoE blocks are unsupported here
+        (top-1 routing is trainable batch machinery; decode serving
+        for experts would dispatch per token — not built)."""
+        if self.experts:
+            raise NotImplementedError(
+                "KV-cache decode for MoE blocks not supported")
+        cache_len = cache[0]["k"].shape[1]
+        try:  # concrete pos (the eager step-by-step pattern): loud OOB
+            if int(pos) >= cache_len:
+                raise ValueError(
+                    f"pos {int(pos)} out of range for cache length "
+                    f"{cache_len} — dynamic_update_slice would silently "
+                    "clamp onto the last slot")
+        except TypeError:
+            pass  # traced pos: generate() bounds it via max_len
+        b = tok.shape[0]
+        dh = self.dim // self.heads
+        scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+        x = params["embed"]["table"][tok]                  # [B, D]
+        new_cache = []
+        for i in range(self.layers):
+            p = params[f"block_{i}"]
+            h = _layer_norm(x, {"gamma": p["norm1_gamma"],
+                                "beta": p["norm1_beta"]})
+            q = (h @ p["wq"]).reshape(b, self.heads, dh)
+            k_t = (h @ p["wk"]).reshape(b, self.heads, dh)
+            v_t = (h @ p["wv"]).reshape(b, self.heads, dh)
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], k_t[:, None], pos, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], v_t[:, None], pos, axis=1)
+            new_cache.append({"k": kc, "v": vc})
+            scores = jnp.einsum("bhd,bshd->bhs", q, kc) * scale
+            live = jnp.arange(kc.shape[1]) <= pos          # [S]
+            scores = jnp.where(live[None, None, :], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1)
+            att = jnp.einsum("bhs,bshd->bhd", w, vc)
+            x = x + att.reshape(b, self.dim) @ p["wo"]
+            h = _layer_norm(x, {"gamma": p["norm2_gamma"],
+                                "beta": p["norm2_beta"]})
+            x = x + jax.nn.gelu(h @ p["w_up"] + p["b_up"]) @ p["w_down"] \
+                + p["b_down"]
+        x = _layer_norm(x, params["final_norm"])
+        return x @ params["embed"]["table"].T, new_cache
+
+    def generate(self, params, prompt, max_new: int, *,
+                 temperature: float = 0.0, rng=None):
+        """Autoregressive continuation: ``prompt`` [B, P] int32 →
+        [B, max_new] int32. One jitted program: prefill scans
+        :meth:`decode_step` over the prompt (filling the cache),
+        generation scans it over ``max_new`` steps feeding each
+        prediction back in. ``temperature=0`` is greedy argmax;
+        otherwise softmax sampling with ``rng`` (a jax PRNG key).
+        Total length must fit ``max_len``."""
+        prompt = jnp.asarray(prompt, jnp.int32)
+        b, plen = prompt.shape
+        total = plen + max_new
+        if total > self.max_len:
+            raise ValueError(f"prompt {plen} + max_new {max_new} exceeds "
+                             f"max_len {self.max_len}")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if temperature > 0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs rng=")
+
+        def run(params, prompt, key):
+            def prefill_step(cache, t):
+                pos, tok = t
+                logits, cache = self.decode_step(params, tok, cache, pos)
+                return cache, logits
+
+            def pick(logits, step_key):
+                if temperature > 0:
+                    return jax.random.categorical(
+                        step_key, logits / temperature,
+                        axis=-1).astype(jnp.int32)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def gen_step(carry, t):
+                cache, tok = carry
+                pos, step_key = t
+                logits, cache = self.decode_step(params, tok, cache, pos)
+                nxt = pick(logits, step_key)
+                return (cache, nxt), nxt
+
+            cache = self.init_cache(b, total)
+            cache, logits = jax.lax.scan(
+                prefill_step, cache, (jnp.arange(plen), prompt.T))
+            first = pick(logits[-1], jax.random.fold_in(key, 0))
+            if max_new == 1:
+                return first[:, None]
+            keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+                jnp.arange(1, max_new))
+            (_c, _t), rest = jax.lax.scan(
+                gen_step, (cache, first),
+                (plen + jnp.arange(max_new - 1), keys))
+            return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        jit_key = (b, plen, max_new, float(temperature))
+        fn = self._gen_jits.get(jit_key)
+        if fn is None:
+            if len(self._gen_jits) >= 32:
+                # bound the per-geometry program cache (serving with
+                # unbucketed prompt lengths would otherwise grow it
+                # forever); FIFO eviction is fine at this size
+                self._gen_jits.pop(next(iter(self._gen_jits)))
+            fn = self._gen_jits[jit_key] = jax.jit(run)
+        return fn(params, prompt, key)
 
     # -- training loss -----------------------------------------------------
     def loss_fn(self, *, mesh=None, use_pallas: bool = False,
