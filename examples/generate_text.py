@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Train a tiny causal LM and decode from it with the KV cache.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/generate_text.py
+
+Beyond the reference's capability surface (sparkdl has no LM path):
+trains TinyCausalLM on a toy copy task with the standard Trainer, then
+generates continuations via the static-shape KV-cache decode path
+(prefill + generation as one jitted program) — greedy and sampled.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+
+def main():
+    import optax
+
+    from tpudl.train import Trainer
+    from tpudl.zoo.transformer import TinyCausalLM
+
+    vocab, period = 16, 4
+    lm = TinyCausalLM(vocab=vocab, dim=64, heads=4, layers=2, max_len=128)
+    params = lm.init(0)
+
+    # toy task: periodic sequences — the LM must learn to repeat them
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, vocab, size=(8, period), dtype=np.int32)
+    toks = np.tile(base, (1, 8))  # [8, 32]
+
+    import jax.numpy as jnp
+
+    l0 = float(lm.loss_fn()(params, jnp.asarray(toks)))
+    trainer = Trainer(lm.loss_fn(), optax.adam(3e-3))
+    params, _, hist = trainer.fit(params, lambda s: (toks,), steps=150)
+    print(f"loss {l0:.3f} -> {hist[-1]['loss']:.3f}")
+
+    prompt = np.tile(base[:1], (1, 3))  # 3 periods of sequence 0
+    out = lm.generate(params, prompt, max_new=8)
+    print("prompt    :", prompt[0].tolist())
+    print("greedy    :", out[0].tolist())
+    print("expected  :", np.tile(base[0], 3)[:8].tolist())
+    sampled = lm.generate(params, prompt, max_new=8, temperature=0.7,
+                          rng=jax.random.PRNGKey(1))
+    print("sampled   :", sampled[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

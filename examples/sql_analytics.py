@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Featurize-then-analyze in SQL — the post-featurization workflow a
+sparkdl user runs in Spark SQL (ref: sparkdl udf/keras_image_model.py
+registerKerasImageUDF + spark.sql), single-table tpudl-native.
+
+    python examples/sql_analytics.py
+
+Builds a small labeled frame, registers a model UDF, and runs the
+SELECT → WHERE → GROUP BY/aggregate → ORDER BY pipeline entirely in
+tpudl (WHERE prunes rows BEFORE the model runs; LIMIT pushes down).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tpudl import register_udf, sql
+from tpudl.frame import Frame
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 64
+    t = Frame({
+        "label": np.array([("cat", "dog", "fox")[i % 3] for i in range(n)],
+                          dtype=object),
+        "x": rng.normal(size=n).astype(np.float32),
+    })
+
+    # any batched frame->frame fn registers as a UDF; model UDFs
+    # (registerKerasImageUDF / makeGraphUDF) work identically
+    register_udf("score", lambda f: f.with_column(
+        "y", np.tanh(np.asarray(f["x"]))), "x", "y")
+
+    feats = sql("SELECT label, score(x) AS y FROM t WHERE x IS NOT NULL",
+                {"t": t})
+    print(f"featurized {len(feats)} rows -> columns {feats.columns}")
+
+    stats = sql(
+        "SELECT label, COUNT(*) AS n, AVG(y) AS mean_y, MAX(y) AS top "
+        "FROM f GROUP BY label ORDER BY mean_y DESC",
+        {"f": feats})
+    for row in stats.collect():
+        print(f"  {row['label']:>4}: n={row['n']:2d} "
+              f"mean_y={row['mean_y']:+.3f} top={row['top']:+.3f}")
+
+    top = sql("SELECT label, y FROM f ORDER BY y DESC LIMIT 3", {"f": feats})
+    print("top-3 rows:", [(r["label"], round(float(r["y"]), 3))
+                          for r in top.collect()])
+
+
+if __name__ == "__main__":
+    main()

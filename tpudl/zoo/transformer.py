@@ -392,10 +392,12 @@ class TinyCausalLM:
         """One incremental step: token ids ``tok`` [B] at position
         ``pos`` (traced scalar) → (logits [B, vocab], updated cache).
 
-        Same block math as :meth:`apply` (oracle-pinned in
-        tests/test_transformer.py) but attention reads the K/V CACHE:
-        scores over positions 0..pos only (mask on a static length),
-        new K/V written at ``pos``. MoE blocks are unsupported here
+        Routes through :meth:`_decoder_block` — the single definition
+        of the block math — with a cache-aware ``attn`` callback: the
+        block's freshly-projected K/V for this one token are written at
+        ``pos`` and attention reads the whole cache masked to
+        0..pos (oracle-pinned against :meth:`apply` in
+        tests/test_transformer.py). MoE blocks are unsupported here
         (top-1 routing is trainable batch machinery; decode serving
         for experts would dispatch per token — not built)."""
         if self.experts:
@@ -410,34 +412,35 @@ class TinyCausalLM:
                     "clamp onto the last slot")
         except TypeError:
             pass  # traced pos: generate() bounds it via max_len
-        b = tok.shape[0]
-        dh = self.dim // self.heads
-        scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
-        x = params["embed"]["table"][tok]                  # [B, D]
+        x = params["embed"]["table"][tok][:, None]         # [B, 1, D]
         new_cache = []
+
+        def cached_attn(layer):
+            def attn(q, k_t, v_t):  # all [B, 1, H, Dh] from the block
+                # scale in q's dtype (attention_reference discipline) —
+                # an f32 scalar would silently promote the whole decode
+                # path out of bf16
+                scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+                kc = jax.lax.dynamic_update_slice_in_dim(
+                    cache[layer]["k"], k_t.astype(cache[layer]["k"].dtype),
+                    pos, axis=1)
+                vc = jax.lax.dynamic_update_slice_in_dim(
+                    cache[layer]["v"], v_t.astype(cache[layer]["v"].dtype),
+                    pos, axis=1)
+                new_cache.append({"k": kc, "v": vc})
+                scores = jnp.einsum("bqhd,bshd->bhqs", q, kc) * scale
+                live = jnp.arange(kc.shape[1]) <= pos      # [S]
+                scores = jnp.where(live[None, None, None, :], scores,
+                                   -jnp.inf)
+                w = jax.nn.softmax(scores, axis=-1)
+                return jnp.einsum("bhqs,bshd->bqhd", w, vc)
+
+            return attn
+
         for i in range(self.layers):
-            p = params[f"block_{i}"]
-            h = _layer_norm(x, {"gamma": p["norm1_gamma"],
-                                "beta": p["norm1_beta"]})
-            q = (h @ p["wq"]).reshape(b, self.heads, dh)
-            k_t = (h @ p["wk"]).reshape(b, self.heads, dh)
-            v_t = (h @ p["wv"]).reshape(b, self.heads, dh)
-            kc = jax.lax.dynamic_update_slice_in_dim(
-                cache[i]["k"], k_t[:, None], pos, axis=1)
-            vc = jax.lax.dynamic_update_slice_in_dim(
-                cache[i]["v"], v_t[:, None], pos, axis=1)
-            new_cache.append({"k": kc, "v": vc})
-            scores = jnp.einsum("bhd,bshd->bhs", q, kc) * scale
-            live = jnp.arange(kc.shape[1]) <= pos          # [S]
-            scores = jnp.where(live[None, None, :], scores, -jnp.inf)
-            w = jax.nn.softmax(scores, axis=-1)
-            att = jnp.einsum("bhs,bshd->bhd", w, vc)
-            x = x + att.reshape(b, self.dim) @ p["wo"]
-            h = _layer_norm(x, {"gamma": p["norm2_gamma"],
-                                "beta": p["norm2_beta"]})
-            x = x + jax.nn.gelu(h @ p["w_up"] + p["b_up"]) @ p["w_down"] \
-                + p["b_down"]
-        x = _layer_norm(x, params["final_norm"])
+            x = self._decoder_block(x, params[f"block_{i}"],
+                                    cached_attn(i))
+        x = _layer_norm(x[:, 0], params["final_norm"])
         return x @ params["embed"]["table"].T, new_cache
 
     def generate(self, params, prompt, max_new: int, *,
@@ -461,10 +464,14 @@ class TinyCausalLM:
             raise ValueError("sampling (temperature > 0) needs rng=")
 
         def run(params, prompt, key):
-            def prefill_step(cache, t):
+            def prefill_step(carry, t):
+                cache, _ = carry
                 pos, tok = t
                 logits, cache = self.decode_step(params, tok, cache, pos)
-                return cache, logits
+                # logits ride the CARRY (only the last position's are
+                # used) — a stacked scan output would materialize
+                # [plen, B, vocab]
+                return (cache, logits), None
 
             def pick(logits, step_key):
                 if temperature > 0:
@@ -480,10 +487,15 @@ class TinyCausalLM:
                 nxt = pick(logits, step_key)
                 return (cache, nxt), nxt
 
-            cache = self.init_cache(b, total)
-            cache, logits = jax.lax.scan(
-                prefill_step, cache, (jnp.arange(plen), prompt.T))
-            first = pick(logits[-1], jax.random.fold_in(key, 0))
+            # cache dtype follows the params (bf16 serving works)
+            cache = self.init_cache(
+                b, total, dtype=params["embed"]["table"].dtype)
+            (cache, logits), _ = jax.lax.scan(
+                prefill_step,
+                (cache, jnp.zeros((b, self.vocab),
+                                  params["embed"]["table"].dtype)),
+                (jnp.arange(plen), prompt.T))
+            first = pick(logits, jax.random.fold_in(key, 0))
             if max_new == 1:
                 return first[:, None]
             keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
