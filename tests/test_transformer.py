@@ -355,3 +355,32 @@ class TestKVCacheDecode:
         out = np.asarray(lm.generate(params, prompt, max_new=5))
         assert out.shape == (2, 5)
         assert out.min() >= 0 and out.max() < 32
+
+    def test_generate_from_restored_checkpoint(self, lm, tmp_path):
+        """The serving flow end to end: train a step, checkpoint,
+        restore into a fresh process-equivalent (new pytree), decode —
+        continuation must equal decoding from the live params."""
+        import optax
+
+        from tpudl.train import Trainer
+
+        toks = np.random.default_rng(5).integers(0, 32, (4, 17),
+                                                 dtype=np.int32)
+        trainer = Trainer(lm.loss_fn(), optax.adam(1e-2),
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          save_every=1)
+        params, _, _ = trainer.fit(lm.init(0), lambda s: (toks,), steps=2)
+
+        from tpudl.train import CheckpointManager
+
+        with CheckpointManager(str(tmp_path / "ck")) as mgr:
+            like = {"params": lm.init(0),
+                    "opt_state": optax.adam(1e-2).init(lm.init(0)),
+                    "step": np.asarray(0, np.int64)}
+            restored = mgr.restore(like=like)
+        assert restored is not None and int(restored["step"]) == 2
+        prompt = toks[:, :6]
+        live = np.asarray(lm.generate(params, prompt, max_new=7))
+        cold = np.asarray(lm.generate(restored["params"], prompt,
+                                      max_new=7))
+        np.testing.assert_array_equal(cold, live)
