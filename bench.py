@@ -29,12 +29,18 @@ resize the featurize run; TPUDL_BENCH_DTYPE picks the compute
 precision. Streaming-phase knobs: TPUDL_BENCH_STREAM_TRIALS (per-arm
 subprocess trials, 0 disables), TPUDL_BENCH_STREAM_BUDGET_S (stop
 starting trials past this wall-clock), TPUDL_BENCH_TRIAL_TIMEOUT_S
-(per-subprocess kill). TPUDL_BENCH_DEADLINE_S bounds the whole run.
+(per-subprocess kill). TPUDL_BENCH_BUDGET_S (default 2400) is the
+run's wall-clock budget: once spent, remaining sub-benches are SKIPPED
+(recorded in ``skipped_sub_benches``, summary flagged ``partial``) so
+the final line always lands inside the driver's window;
+TPUDL_BENCH_DEADLINE_S is the hard watchdog backstop for a wedged
+backend RPC, and SIGTERM flushes a partial summary before exit.
 Everything except the final JSON line goes to stderr.
 """
 
 import json
 import os
+import signal
 import statistics
 import sys
 import tempfile
@@ -49,7 +55,75 @@ def log(*a):
 
 
 _EMITTED = threading.Event()
+_EMIT_DONE = threading.Event()  # summary line fully printed
+# NOTE: never call _emit from a signal handler — it may interrupt an
+# in-progress _emit on this very thread and deadlock on this lock; the
+# SIGTERM handler prints its summary line directly instead
 _EMIT_LOCK = threading.Lock()
+
+# -- wall-clock budget (round-5 fix: BENCH_r05.json rc=124/parsed=null —
+# the run outlived the driver's timeout and never printed the summary).
+# Sub-benches are SKIPPED once the budget is spent, so the final JSON
+# line always lands well inside the driver's window; the watchdog
+# (TPUDL_BENCH_DEADLINE_S) stays as the hard backstop for a wedged RPC.
+_BUDGET_T0 = time.monotonic()
+
+
+def _budget_s() -> float:
+    return float(os.environ.get("TPUDL_BENCH_BUDGET_S", "2400"))
+
+
+def _budget_left() -> float:
+    return _budget_s() - (time.monotonic() - _BUDGET_T0)
+
+
+def _gate(record: dict, key: str) -> bool:
+    """True = run the sub-bench; False = budget spent — record the skip
+    and mark the run partial."""
+    if _budget_left() > 0:
+        return True
+    log(f"bench budget {_budget_s():.0f}s spent — skipping {key}")
+    record.setdefault("skipped_sub_benches", []).append(key)
+    record["partial"] = True
+    return False
+
+
+def _install_sigterm_flush(record: dict):
+    """SIGTERM (the driver's kill) flushes whatever has been measured so
+    far as the final summary line and exits 0 — the judged record must
+    survive an external timeout. Returns the handler (tests call it
+    directly)."""
+
+    def handler(signum, frame):
+        log(f"signal {signum} received — flushing partial record")
+        if _EMIT_DONE.is_set():
+            os._exit(0)  # summary already fully printed
+        # Print the summary line DIRECTLY — not via _emit: the handler
+        # may have interrupted an in-progress _emit on this very thread
+        # (which can never resume once we _exit), so taking its lock or
+        # honoring its latch could deadlock or drop the line. The
+        # leading newline terminates any half-printed line so this one
+        # is always a clean, parseable LAST line.
+        partial = dict(record)
+        partial.setdefault("value", None)
+        partial["partial"] = True
+        partial["sigterm"] = True
+        try:
+            line = json.dumps(_compact_summary(partial), default=str)
+        except Exception as e:
+            line = json.dumps(
+                {"metric": partial.get("metric"),
+                 "value": partial.get("value"),
+                 "unit": partial.get("unit"), "vs_baseline": None,
+                 "summary_error": repr(e)[:200]}, default=str)
+        print("\n" + line, flush=True)
+        os._exit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:  # not the main thread (in-process tests)
+        pass
+    return handler
 
 
 def _scalar(v):
@@ -66,7 +140,7 @@ def _compact_summary(record: dict) -> dict:
     s = {k: record.get(k) for k in ("metric", "value", "unit",
                                     "vs_baseline")}
     for k in ("headline_mode", "compute_dtype", "batch_size",
-              "deadline_hit"):
+              "deadline_hit", "partial", "sigterm"):
         if k in record:
             s[k] = _scalar(record[k])
     stream = record.get("featurize_streaming") or {}
@@ -158,6 +232,7 @@ def _emit(record: dict):
              "vs_baseline": record.get("vs_baseline"),
              "summary_error": repr(e)[:200]}, default=str)
     print(line, flush=True)
+    _EMIT_DONE.set()
 
 
 def _start_watchdog(record: dict):
@@ -178,6 +253,7 @@ def _start_watchdog(record: dict):
             partial = dict(record)
             partial.setdefault("value", None)
             partial["deadline_hit"] = True
+            partial["partial"] = True
             _emit(partial)
             os._exit(0)
 
@@ -221,6 +297,12 @@ def run_featurize_trial(arm, n, batch, dtype):
 
     enable_compilation_cache()
     os.environ["TPUDL_FRAME_PREFETCH"] = "1" if arm == "prefetch" else "0"
+    if arm == "prefetch":
+        # the pipelined arm is the FULL staged executor: parallel
+        # prepare + K-deep infeed + multi-step fused dispatch (one
+        # tunnel round-trip per M batches — the headline lever); the
+        # serial arm (TPUDL_FRAME_PREFETCH=0) force-disables all three
+        os.environ.setdefault("TPUDL_FRAME_FUSE_STEPS", "4")
     feat = DeepImageFeaturizer(inputCol="image", outputCol="features",
                                modelName="InceptionV3", batchSize=batch,
                                computeDtype=dtype)
@@ -235,6 +317,15 @@ def run_featurize_trial(arm, n, batch, dtype):
     rec = {"arm": arm, "images_per_sec": round(n / dt, 1),
            "transform_seconds": round(dt, 2),
            "warmup_seconds": round(warm_s, 1), "n": n, "batch": batch}
+    try:
+        from tpudl import obs
+
+        # per-stage executor breakdown (decode/pack, h2d, dispatch, d2h)
+        # + queue-depth/overlap gauges — the judged record carries the
+        # pipeline's own accounting of where the wall-clock went
+        rec["pipeline"] = obs.last_pipeline_report()
+    except Exception as e:
+        log(f"pipeline report unavailable: {e!r}")
     try:
         bw = measure_wire_bandwidth(mb=8)
         rec["h2d_mb_per_sec_post"] = bw["h2d_mb_per_sec"]
@@ -263,8 +354,10 @@ def measure_featurize_streaming(n, batch, dtype, per_arm=4, extra=None):
 
     timeout = float(os.environ.get("TPUDL_BENCH_TRIAL_TIMEOUT_S", "450"))
     # stop STARTING new trials past this wall-clock budget so the phase
-    # can never out-run the watchdog deadline on a degraded tunnel
-    budget = float(os.environ.get("TPUDL_BENCH_STREAM_BUDGET_S", "1500"))
+    # can never out-run the watchdog deadline on a degraded tunnel —
+    # and never past the whole run's TPUDL_BENCH_BUDGET_S either
+    budget = min(float(os.environ.get("TPUDL_BENCH_STREAM_BUDGET_S", "1500")),
+                 max(0.0, _budget_left()))
     phase_start = time.perf_counter()
     arms = {"prefetch": [], "serial": []}
     pairs, failures = [], []
@@ -397,8 +490,28 @@ def measure_featurize(n, batch, dtype, trials=5):
     feat = DeepImageFeaturizer(inputCol="image", outputCol="features",
                                modelName="InceptionV3", batchSize=batch,
                                computeDtype=dtype)
+    prev = os.environ.get("TPUDL_FRAME_PREFETCH")  # restore user's choice
+    prev_fuse = os.environ.get("TPUDL_FRAME_FUSE_STEPS")
     t0 = time.perf_counter()
-    feat.transform(make_frame(batch))  # compile+warmup
+    feat.transform(make_frame(batch))  # compile+warmup (per-batch program)
+    if prev_fuse is None:
+        os.environ["TPUDL_FRAME_FUSE_STEPS"] = "4"
+    try:
+        fuse_now = int(os.environ.get("TPUDL_FRAME_FUSE_STEPS", "1"))
+    except ValueError:
+        fuse_now = 1
+    if fuse_now > 1:
+        # the prefetch arm below runs the FULL pipelined executor with
+        # fused dispatch; warm that compile here, OUTSIDE the timed
+        # trials (warmup() compiles the fused scan too, without a
+        # fetch) — whether the fuse depth came from our default above
+        # or the operator's own env
+        try:
+            feat.warmup(299, 299)
+        except Exception as e:
+            log(f"fused warmup failed (arm falls back per-batch): {e!r}")
+            if prev_fuse is None:
+                os.environ["TPUDL_FRAME_FUSE_STEPS"] = "1"
     warmup_s = time.perf_counter() - t0
     log(f"compile+warmup: {warmup_s:.1f}s")
 
@@ -414,7 +527,7 @@ def measure_featurize(n, batch, dtype, trials=5):
 
     arms = {"prefetch": [], "serial": []}
     pairs = []
-    prev = os.environ.get("TPUDL_FRAME_PREFETCH")  # restore user's choice
+    stage_reports = {}  # one per arm: the executor's own breakdown
     try:
         for t in range(per_arm):
             # counterbalanced order: a drifting link otherwise favors
@@ -422,6 +535,9 @@ def measure_featurize(n, batch, dtype, trials=5):
             order = (("prefetch", "serial") if t % 2 == 0
                      else ("serial", "prefetch"))
             for arm in order:
+                # the pipelined arm is the FULL staged executor (prefetch
+                # pool + the fused dispatch warmed above); PREFETCH=0
+                # force-disables both in the serial arm
                 os.environ["TPUDL_FRAME_PREFETCH"] = (
                     "1" if arm == "prefetch" else "0")
                 bw_pre = probe()
@@ -429,6 +545,12 @@ def measure_featurize(n, batch, dtype, trials=5):
                 out = feat.transform(frame)
                 np.asarray(out["features"][-1])  # materialized; paranoia
                 dt = time.perf_counter() - t0
+                try:
+                    from tpudl import obs
+
+                    stage_reports[arm] = obs.last_pipeline_report()
+                except Exception:
+                    pass
                 bw_post = probe()
                 rate = n / dt
                 arms[arm].append(rate)
@@ -449,6 +571,10 @@ def measure_featurize(n, batch, dtype, trials=5):
             os.environ.pop("TPUDL_FRAME_PREFETCH", None)
         else:
             os.environ["TPUDL_FRAME_PREFETCH"] = prev
+        if prev_fuse is None:
+            os.environ.pop("TPUDL_FRAME_FUSE_STEPS", None)
+        else:
+            os.environ["TPUDL_FRAME_FUSE_STEPS"] = prev_fuse
 
     value = statistics.median(arms["prefetch"])
     serial = statistics.median(arms["serial"])
@@ -475,6 +601,7 @@ def measure_featurize(n, batch, dtype, trials=5):
             "wire_normalized_efficiency": eff_med,
             "spread_pct": round(100 * spread, 1),
             "serial_infeed_images_per_sec": round(serial, 1),
+            "pipeline_reports": stage_reports,
             "warmup_seconds": round(warmup_s, 1)}
 
 
@@ -1276,6 +1403,8 @@ def main():
         "baseline": "keras InceptionV3 on TF-CPU (fp32), this host",
     }
     _start_watchdog(extra)
+    _install_sigterm_flush(extra)
+    log(f"bench budget: {_budget_s():.0f}s (TPUDL_BENCH_BUDGET_S)")
 
     # 1) Streaming-mode subprocess trials FIRST, before this process
     #    initializes its backend: TPU runtimes are single-process-per-
@@ -1283,7 +1412,7 @@ def main():
     #    subprocess needs it. Each trial is a fresh process = fresh
     #    streaming mode (see run_featurize_trial).
     feat_stream = None
-    if stream_trials > 0:
+    if stream_trials > 0 and _gate(extra, "featurize_streaming"):
         try:
             # writes value/headline_mode/featurize_streaming into
             # ``extra`` incrementally as trials complete (watchdog-safe)
@@ -1303,7 +1432,7 @@ def main():
     log(f"backend: {devs[0].platform} x{len(devs)} ({devs[0].device_kind})")
     log(f"persistent compile cache: {cache_dir or 'disabled'}")
 
-    if devs[0].platform == "tpu":
+    if devs[0].platform == "tpu" and _gate(extra, "streaming_mode_e2e"):
         try:
             # valid only before the parent's first device->host read —
             # the subprocess trials above fetched in THEIR processes,
@@ -1313,60 +1442,69 @@ def main():
         except Exception as e:
             log(f"streaming-mode sub-bench failed: {e!r}")
 
-    feat = measure_featurize(n, batch, dtype, trials)
-    extra.update({
-        "featurize_sync_mode": {
-            "value": feat["value"],
-            "trials": feat["trials"],
-            "serial_trials": feat["serial_trials"],
-            "interleaved_pairs": feat["interleaved_pairs"],
-            "wire_normalized_efficiency":
-                feat["wire_normalized_efficiency"],
-            "spread_pct": feat["spread_pct"],
-            "serial_infeed_images_per_sec":
-                feat["serial_infeed_images_per_sec"],
-        },
-        "compile_warmup_seconds": feat["warmup_seconds"],
-    })
-    if not feat_stream:
-        extra["value"] = feat["value"]
-        extra["headline_mode"] = "synchronized_in_process"
-    try:
-        # batch 256 profiled BEST for device MFU (PROFILE.md sweep:
-        # 256→22.8%, 1024→20.4%) and its 68 MB device_put is 4× less
-        # likely to wedge a degraded tunnel than 1024's 274 MB
-        compute_batch = int(os.environ.get("TPUDL_BENCH_COMPUTE_BATCH",
-                                           "256"))
-        compute_ips = measure_compute_only(compute_batch, dtype)
-        extra["compute_only_images_per_sec"] = round(compute_ips, 1)
-        extra["compute_only_batch"] = compute_batch
-    except Exception as e:  # sub-bench failure must not kill the bench
-        log(f"compute-only sub-bench failed: {e!r}")
-        extra["compute_only_images_per_sec"] = None
-        compute_ips = None
-    try:
-        extra["wire_bandwidth"] = measure_wire_bandwidth()
-        # each 299x299x3 uint8 image is ~268KB on the wire; the implied
-        # ceiling makes the wire-bound diagnosis auditable in the record
-        img_mb = 299 * 299 * 3 / 2**20
-        extra["wire_bound_images_per_sec"] = round(
-            extra["wire_bandwidth"]["h2d_mb_per_sec"] / img_mb, 1)
-    except Exception as e:
-        log(f"wire-bandwidth probe failed: {e!r}")
+    if _gate(extra, "featurize_sync_mode"):
+        feat = measure_featurize(n, batch, dtype, trials)
+        extra.update({
+            "featurize_sync_mode": {
+                "value": feat["value"],
+                "trials": feat["trials"],
+                "serial_trials": feat["serial_trials"],
+                "interleaved_pairs": feat["interleaved_pairs"],
+                "wire_normalized_efficiency":
+                    feat["wire_normalized_efficiency"],
+                "spread_pct": feat["spread_pct"],
+                "serial_infeed_images_per_sec":
+                    feat["serial_infeed_images_per_sec"],
+                "pipeline_reports": feat["pipeline_reports"],
+            },
+            "compile_warmup_seconds": feat["warmup_seconds"],
+        })
+        if not feat_stream:
+            extra["value"] = feat["value"]
+            extra["headline_mode"] = "synchronized_in_process"
+    elif not feat_stream:
+        extra.setdefault("value", None)
+        extra["headline_mode"] = "skipped_budget"
+    compute_ips = None
+    if _gate(extra, "compute_only"):
+        try:
+            # batch 256 profiled BEST for device MFU (PROFILE.md sweep:
+            # 256→22.8%, 1024→20.4%) and its 68 MB device_put is 4× less
+            # likely to wedge a degraded tunnel than 1024's 274 MB
+            compute_batch = int(os.environ.get("TPUDL_BENCH_COMPUTE_BATCH",
+                                               "256"))
+            compute_ips = measure_compute_only(compute_batch, dtype)
+            extra["compute_only_images_per_sec"] = round(compute_ips, 1)
+            extra["compute_only_batch"] = compute_batch
+        except Exception as e:  # sub-bench failure must not kill the bench
+            log(f"compute-only sub-bench failed: {e!r}")
+            extra["compute_only_images_per_sec"] = None
+    if _gate(extra, "wire_bandwidth"):
+        try:
+            extra["wire_bandwidth"] = measure_wire_bandwidth()
+            # each 299x299x3 uint8 image is ~268KB on the wire; the implied
+            # ceiling makes the wire-bound diagnosis auditable in the record
+            img_mb = 299 * 299 * 3 / 2**20
+            extra["wire_bound_images_per_sec"] = round(
+                extra["wire_bandwidth"]["h2d_mb_per_sec"] / img_mb, 1)
+        except Exception as e:
+            log(f"wire-bandwidth probe failed: {e!r}")
     if devs[0].platform == "tpu":  # peak constant is the v5e figure
-        extra["mfu_end_to_end"] = round(
-            extra["value"] * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS, 5)
+        if extra.get("value"):
+            extra["mfu_end_to_end"] = round(
+                extra["value"] * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS, 5)
         if compute_ips:
             extra["mfu_compute"] = round(
                 compute_ips * _INCEPTION_FLOPS / _V5E_PEAK_FLOPS, 5)
-        try:
-            # dispatch-free chip-side number (batch 256 profiled best in
-            # the PROFILE.md sweep)
-            dev = measure_device_profile(batch, dtype)
-            if dev:
-                extra["device_profile"] = dev
-        except Exception as e:
-            log(f"device-profile sub-bench failed: {e!r}")
+        if _gate(extra, "device_profile"):
+            try:
+                # dispatch-free chip-side number (batch 256 profiled best
+                # in the PROFILE.md sweep)
+                dev = measure_device_profile(batch, dtype)
+                if dev:
+                    extra["device_profile"] = dev
+            except Exception as e:
+                log(f"device-profile sub-bench failed: {e!r}")
 
     if os.environ.get("TPUDL_BENCH_QUICK", "0") != "1":
         # device-facing sub-benches get contemporaneous wire probes
@@ -1382,6 +1520,8 @@ def main():
                         ("estimator_inception", measure_estimator_inception),
                         ("decode", measure_decode),
                         ("flash_attention", measure_flash_attention)]:
+            if not _gate(extra, key):
+                continue
             try:
                 pre = _quiet_wire_probe() if key in probed else None
                 rec = fn()
@@ -1394,7 +1534,8 @@ def main():
                 extra[key] = {"error": repr(e)}
 
     base = None
-    if os.environ.get("TPUDL_BENCH_SKIP_BASELINE", "0") != "1":
+    if (os.environ.get("TPUDL_BENCH_SKIP_BASELINE", "0") != "1"
+            and _gate(extra, "tf_cpu_baseline")):
         try:
             base = measure_tf_cpu_baseline()
             extra["tf_cpu_baseline_images_per_sec"] = round(base["value"], 2)
@@ -1402,8 +1543,9 @@ def main():
         except Exception as e:  # baseline failure must not kill the bench
             log(f"baseline measurement failed: {e!r}")
 
+    extra.setdefault("value", None)
     extra["vs_baseline"] = (round(extra["value"] / base["value"], 3)
-                            if base else None)
+                            if base and extra["value"] else None)
     # canonical key order for the judged line
     out = {k: extra[k] for k in ("metric", "value", "unit", "vs_baseline")}
     out.update({k: v for k, v in extra.items() if k not in out})

@@ -116,6 +116,74 @@ def test_emit_writes_full_record_and_prints_summary_last(
         bench._EMITTED.clear()
 
 
+def test_quick_run_under_tight_budget_emits_summary_last(tmp_path):
+    """The round-6 budget contract: a QUICK run whose TPUDL_BENCH_BUDGET_S
+    is already spent must SKIP every sub-bench, exit 0 fast, and still
+    print a parseable compact summary (flagged partial) as the LAST
+    stdout line — the failure mode this kills is BENCH_r05.json's
+    rc=124/parsed=null driver timeout."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "TPUDL_BENCH_QUICK": "1",
+        "TPUDL_BENCH_BUDGET_S": "0",       # budget spent at t=0
+        "TPUDL_BENCH_STREAM_TRIALS": "0",
+        "TPUDL_BENCH_SKIP_BASELINE": "1",
+        "TPUDL_BENCH_RECORD_NAME": "contract_budget_test",
+    })
+    rec_path = os.path.join(REPO, "bench_records",
+                            "contract_budget_test.json")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert lines, "bench printed nothing to stdout"
+        s = json.loads(lines[-1])  # the driver's parse of the tail
+        assert s["partial"] is True
+        assert "value" in s and "metric" in s
+        assert len(lines[-1]) < 1500
+        with open(rec_path) as f:
+            stored = json.load(f)
+        assert stored["skipped_sub_benches"]  # budget skips are recorded
+    finally:
+        if os.path.exists(rec_path):
+            os.remove(rec_path)
+
+
+def test_sigterm_handler_flushes_partial_summary(bench, monkeypatch,
+                                                 capsys):
+    """SIGTERM (the driver's kill) must flush whatever has been measured
+    as a valid last-line summary before exiting."""
+    monkeypatch.setenv("TPUDL_BENCH_RECORD_NAME", "contract_sigterm_test")
+    rec_path = os.path.join(REPO, "bench_records",
+                            "contract_sigterm_test.json")
+    bench._EMITTED.clear()
+    bench._EMIT_DONE.clear()
+    exits = []
+    monkeypatch.setattr(bench.os, "_exit", lambda code: exits.append(code))
+    try:
+        record = {"metric": "m", "unit": "u", "vs_baseline": None,
+                  "compute_dtype": "bfloat16"}
+        handler = bench._install_sigterm_flush(record)
+        handler(15, None)
+        out = capsys.readouterr().out.strip().splitlines()
+        s = json.loads(out[-1])
+        assert s["partial"] is True and s["sigterm"] is True
+        assert s["value"] is None
+        assert exits == [0]
+    finally:
+        import signal as _signal
+
+        _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+        if os.path.exists(rec_path):
+            os.remove(rec_path)
+        bench._EMITTED.clear()
+
+
 def test_emit_summary_survives_unserializable_record(bench, monkeypatch,
                                                      capsys):
     """The latch is set before the sinks run: a record a sub-bench

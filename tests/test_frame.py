@@ -201,6 +201,214 @@ class TestPrefetchInfeed:
                 check_finite=True)
 
 
+class TestPipelineExecutor:
+    """The staged pipeline executor (ISSUE 2 tentpole): K-deep infeed
+    fed by an N-worker prepare pool, plus multi-step fused dispatch.
+    All fast (tier-1) — no sleeps longer than a few ms."""
+
+    def test_depth_k_prepares_ahead_and_in_parallel(self):
+        """At depth K=3 with 2 workers, batches k+1 AND k+2 must be in
+        preparation while batch k computes, and two prepares must
+        actually overlap in time (the parallel pool — a single-worker
+        double buffer would serialize them)."""
+        import threading
+        import time as _time
+
+        started = [threading.Event() for _ in range(6)]
+        intervals = []
+        lock = threading.Lock()
+
+        def spy_pack(sl):
+            i = int(np.asarray(sl)[0, 0])
+            started[i].set()
+            t0 = _time.perf_counter()
+            _time.sleep(0.05)  # long enough for pool overlap to show
+            with lock:
+                intervals.append((i, t0, _time.perf_counter()))
+            return np.asarray(sl)
+
+        def fn(b):
+            i = int(np.asarray(b)[0, 0])
+            for ahead in (1, 2):
+                if i + ahead < len(started):
+                    assert started[i + ahead].wait(timeout=10), (
+                        f"batch {i + ahead} not preparing while batch "
+                        f"{i} computed — infeed is not {3}-deep")
+            _time.sleep(0.02)
+            return b * 2
+
+        x = np.repeat(np.arange(6, dtype=np.float32), 4)[:, None]
+        out = Frame({"x": x}).map_batches(
+            fn, ["x"], ["y"], batch_size=4, pack=spy_pack,
+            prefetch=True, prefetch_depth=3, prepare_workers=2)
+        np.testing.assert_allclose(np.stack(list(out["y"])), x * 2)
+        overlaps = sum(
+            1 for (i, s1, e1) in intervals for (j, s2, e2) in intervals
+            if i < j and s2 < e1 and s1 < e2)
+        assert overlaps >= 1, (
+            f"no two prepares overlapped — pool is serial: {intervals}")
+
+    def test_knobs_and_gauges_reported(self, monkeypatch):
+        from tpudl import obs
+
+        monkeypatch.setenv("TPUDL_FRAME_PREFETCH_DEPTH", "4")
+        monkeypatch.setenv("TPUDL_FRAME_PREPARE_WORKERS", "3")
+        x = np.arange(32, dtype=np.float32)
+        Frame({"x": x}).map_batches(lambda b: b + 1, ["x"], ["y"],
+                                    batch_size=4, prefetch=True)
+        rep = obs.last_pipeline_report()
+        assert rep["prefetch_depth"] == 4
+        assert rep["prepare_workers"] == 3
+        assert rep["queue_depth_max"] <= 4
+        assert 0.0 <= rep["overlap_efficiency"] <= 1.0
+        for stage in ("prepare", "dispatch", "infeed_wait"):
+            assert stage in rep["stage_seconds"], rep
+
+    def test_raising_fn_shuts_pool_down_no_lingering_threads(self):
+        import threading
+        import time as _time
+
+        def fn(b):
+            if int(np.asarray(b)[0]) >= 8:  # second batch
+                raise RuntimeError("executor must unwind")
+            return b
+
+        x = np.arange(64, dtype=np.float32)
+        with pytest.raises(RuntimeError, match="must unwind"):
+            Frame({"x": x}).map_batches(fn, ["x"], ["y"], batch_size=8,
+                                        prefetch=True, prefetch_depth=4,
+                                        prepare_workers=2)
+        deadline = _time.perf_counter() + 5.0
+        while _time.perf_counter() < deadline:
+            alive = [t for t in threading.enumerate()
+                     if t.name.startswith("tpudl-infeed") and t.is_alive()]
+            if not alive:
+                break
+            _time.sleep(0.05)
+        assert not alive, f"infeed threads lingered after fn raised: {alive}"
+
+    def test_raising_worker_propagates_original_exception(self):
+        class BoomError(Exception):
+            pass
+
+        def bad_pack(sl):
+            if int(np.asarray(sl)[0]) >= 8:
+                raise BoomError("decode exploded on batch 1")
+            return np.asarray(sl)
+
+        x = np.arange(32, dtype=np.float32)
+        with pytest.raises(BoomError, match="decode exploded"):
+            Frame({"x": x}).map_batches(
+                lambda b: b, ["x"], ["y"], batch_size=8, pack=bad_pack,
+                prefetch=True, prefetch_depth=2, prepare_workers=2)
+
+    def test_fused_dispatch_one_compile_per_group_bitwise_identical(self):
+        """fuse_steps=M: ONE compiled lax.scan program serves every
+        group of M microbatches (fn traces once, dispatches drop M×),
+        and the outputs are bit-identical to the per-batch path."""
+        import jax
+
+        from tpudl import obs
+
+        traces = {"n": 0}
+
+        @jax.jit
+        def jfn(b):
+            traces["n"] += 1  # python side effect: runs once per trace
+            return (b * 3.0 + 0.5).sum(axis=1)
+
+        x = np.arange(64, dtype=np.float32).reshape(16, 4)
+        f = Frame({"x": x})
+        fused = f.map_batches(jfn, ["x"], ["y"], batch_size=2,
+                              fuse_steps=4)
+        rep = obs.last_pipeline_report()
+        assert rep["stage_calls"]["fused_dispatches"] == 2  # 8 batches / 4
+        assert rep["stage_calls"]["dispatch"] == 2
+        assert traces["n"] == 1, "fn must trace ONCE inside the fused scan"
+        serial = f.map_batches(jfn, ["x"], ["y"], batch_size=2,
+                               fuse_steps=1, prefetch=False)
+        np.testing.assert_array_equal(
+            np.asarray(list(fused["y"]), np.float32),
+            np.asarray(list(serial["y"]), np.float32))
+
+    def test_fused_dispatch_handles_ragged_tail(self):
+        import jax
+
+        jfn = jax.jit(lambda b: b * 2)
+        x = np.arange(21, dtype=np.float32)
+        out = Frame({"x": x}).map_batches(jfn, ["x"], ["y"], batch_size=4,
+                                          fuse_steps=2)
+        np.testing.assert_allclose(np.asarray(out["y"], np.float32), x * 2)
+
+    def test_prefetch_kill_switch_disables_fusion_too(self, monkeypatch):
+        import jax
+
+        from tpudl import obs
+
+        monkeypatch.setenv("TPUDL_FRAME_PREFETCH", "0")
+        x = np.arange(16, dtype=np.float32)
+        out = Frame({"x": x}).map_batches(jax.jit(lambda b: b), ["x"],
+                                          ["y"], batch_size=4, fuse_steps=4)
+        np.testing.assert_allclose(np.asarray(out["y"], np.float32), x)
+        rep = obs.last_pipeline_report()
+        assert rep["executor"] == "serial"
+        assert rep["fuse_steps"] == 1
+        assert "fused_dispatches" not in rep["stage_calls"]
+
+    def test_device_fn_kwarg_overrides_heuristic(self):
+        """A plain-python wrapper around a jitted call is invisible to
+        the heuristic; device_fn=True turns the pipeline on anyway."""
+        import threading
+
+        import jax
+
+        jfn = jax.jit(lambda b: b + 1)
+
+        def wrapper(b):  # hides jax.stages.Wrapped from the heuristic
+            return jfn(b)
+
+        names = []
+
+        def spy_pack(sl):
+            names.append(threading.current_thread().name)
+            return np.asarray(sl)
+
+        x = np.arange(16, dtype=np.float32)
+        out = Frame({"x": x}).map_batches(wrapper, ["x"], ["y"],
+                                          batch_size=4, pack=spy_pack,
+                                          device_fn=True)
+        np.testing.assert_allclose(np.asarray(out["y"], np.float32), x + 1)
+        assert all(t.startswith("tpudl-infeed") for t in names), names
+
+    def test_host_fn_returning_device_arrays_warns_once(self):
+        import jax
+
+        import tpudl.frame.frame as frame_mod
+
+        jfn = jax.jit(lambda b: b * 2)
+
+        def wrapper(b):
+            return jfn(b)
+
+        x = np.arange(8, dtype=np.float32)
+        frame_mod._warned_device_outputs = False
+        try:
+            with pytest.warns(RuntimeWarning, match="device arrays"):
+                Frame({"x": x}).map_batches(wrapper, ["x"], ["y"],
+                                            batch_size=4)
+            # second run: warn-once latch holds
+            import warnings as _warnings
+
+            with _warnings.catch_warnings(record=True) as rec:
+                _warnings.simplefilter("always")
+                Frame({"x": x}).map_batches(wrapper, ["x"], ["y"],
+                                            batch_size=4)
+            assert not [w for w in rec
+                        if issubclass(w.category, RuntimeWarning)]
+        finally:
+            frame_mod._warned_device_outputs = False
+
+
 class TestSqlWhere:
     """WHERE / SELECT * support (round-2 verdict weak #8 noted the grammar
     was projection-only; predicates run BEFORE UDF projection so filtered

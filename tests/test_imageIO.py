@@ -349,3 +349,84 @@ class TestLazyInputPlane:
         rows = list(clean["image"])
         assert all(r is not None for r in rows)
         assert col.reads == reads_after_scan + len(clean)
+
+
+class TestDecodeConcurrencyContract:
+    """Round-6 pipeline executor: the prepare pool calls a lazy column's
+    ``_get`` for different batches concurrently, so an UNMARKED custom
+    decoder must still run serially (column-wide lock), while a decoder
+    marked ``thread_safe = True`` (or an explicit decode_workers > 1)
+    opts into concurrency."""
+
+    def _mk(self, tmp_path, n=16):
+        rng = np.random.default_rng(3)
+        for i in range(n):
+            Image.fromarray(
+                rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+            ).save(tmp_path / f"i{i:02d}.png")
+
+    def test_unmarked_decoder_never_runs_concurrently(self, tmp_path):
+        import threading
+        import time
+
+        self._mk(tmp_path)
+        active, peak = [0], [0]
+        lock = threading.Lock()
+
+        def unsafe_decode(raw):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.005)
+            with lock:
+                active[0] -= 1
+            return io_.PIL_decode(raw)
+
+        frame = io_.readImagesWithCustomFn(str(tmp_path), unsafe_decode)
+        out = frame.map_batches(
+            lambda b: np.asarray(b, np.float32).sum(axis=(1, 2, 3)),
+            ["image"], ["s"], batch_size=4, prefetch=True, device_fn=True,
+            prefetch_depth=4, prepare_workers=4,
+            pack=_pack_structs)
+        assert len(out) == 16
+        assert peak[0] == 1, (
+            f"unmarked decoder ran {peak[0]}-way concurrent — the "
+            "serial-decode contract is broken")
+
+    def test_marked_decoder_may_overlap_across_batches(self, tmp_path):
+        import threading
+        import time
+
+        self._mk(tmp_path)
+        active, peak = [0], [0]
+        lock = threading.Lock()
+
+        def safe_decode(raw):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.02)  # wide window so overlap can't flake away
+            with lock:
+                active[0] -= 1
+            return io_.PIL_decode(raw)
+
+        safe_decode.thread_safe = True
+        frame = io_.readImagesWithCustomFn(str(tmp_path), safe_decode)
+        out = frame.map_batches(
+            lambda b: np.asarray(b, np.float32).sum(axis=(1, 2, 3)),
+            ["image"], ["s"], batch_size=2, prefetch=True, device_fn=True,
+            prefetch_depth=8, prepare_workers=4,
+            pack=_pack_structs)
+        assert len(out) == 16
+        assert peak[0] >= 2, (
+            "marked-thread-safe decoder never overlapped — the opt-in "
+            "path is not parallel")
+
+
+def _pack_structs(sl):
+    from tpudl.ml.tf_image import _pack_image_structs
+
+    return _pack_image_structs(sl)
+
+
+_pack_structs.thread_safe = True

@@ -15,6 +15,10 @@ path (ref: sparkdl graph/tensorframes_udf.py, tf_image.py:_transform).
 from __future__ import annotations
 
 import os
+import sys
+import time
+import warnings
+from collections import deque
 from collections.abc import Callable, Iterator, Mapping, Sequence
 from concurrent.futures import ThreadPoolExecutor
 
@@ -86,39 +90,153 @@ class _SubsetLazyColumn(LazyColumn):
         return None if base is None else base[self._indices]
 
 
-class _PrefetchInfeed:
-    """One-deep double-buffered infeed: batch k+1 is packed and
-    host→device-transferred on a worker thread while the main thread
-    dispatches batch k's compute (SURVEY.md §7.3 "double-buffered
-    infeed"). One deep is enough — deeper queues only add host RAM and
-    device-buffer pressure without more overlap."""
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
-    def __init__(self, prepare: Callable, spans: Sequence[tuple[int, int]]):
+
+class _PipelineInfeed:
+    """K-deep bounded infeed fed by an N-worker prepare pool: up to
+    ``depth`` batches are packed/decoded (and, on the mesh path,
+    host→device-transferred) in flight, by up to ``workers`` concurrent
+    threads, while the consumer dispatches compute (the tf.data
+    parallel-prepare + prefetch design, Murray et al. 2021; replaces the
+    round-5 one-deep single-worker double buffer whose serialized PIL
+    decode gated the whole executor). Futures are consumed in submission
+    order, so batch order — and therefore output row order — is
+    preserved no matter which worker finishes first.
+
+    Backpressure: at most ``depth`` prepared batches exist at once, so
+    host RAM stays O(depth · batch) at any dataset size."""
+
+    def __init__(self, prepare: Callable, spans: Sequence[tuple[int, int]],
+                 depth: int = 2, workers: int = 2, report=None):
         self._prepare = prepare
         self._spans = spans
-        self._ex = ThreadPoolExecutor(max_workers=1,
-                                      thread_name_prefix="tpudl-infeed")
-        self._next = (self._ex.submit(prepare, *spans[0]) if spans else None)
+        self._depth = max(1, int(depth))
+        self._ex = ThreadPoolExecutor(
+            max_workers=max(1, min(int(workers), self._depth)),
+            thread_name_prefix="tpudl-infeed")
+        self._futs: deque = deque()
+        self._next = 0
+        self._report = report
+        while self._next < min(self._depth, len(spans)):
+            self._submit()
+
+    def _submit(self):
+        self._futs.append(
+            self._ex.submit(self._prepare, *self._spans[self._next]))
+        self._next += 1
 
     def get(self, i: int):
+        fut = self._futs.popleft()
+        if self._report is not None:
+            # ready-batch count at the moment the consumer takes one:
+            # a depth pinned at 0 means the pool can't keep up (host-
+            # bound); pinned at depth-1 means the device is the gate
+            self._report.gauge("queue_depth",
+                               int(fut.done())
+                               + sum(f.done() for f in self._futs))
+        t0 = time.perf_counter()
         try:
-            out = self._next.result()
+            out = fut.result()
         except BaseException:
-            self._ex.shutdown(wait=False)
-            raise
-        if i + 1 < len(self._spans):
-            self._next = self._ex.submit(self._prepare, *self._spans[i + 1])
-        else:
+            self.close()
+            raise  # the worker's original exception, not a pool wrapper
+        if self._report is not None:
+            self._report.add("infeed_wait", time.perf_counter() - t0)
+        if self._next < len(self._spans):
+            self._submit()
+        elif not self._futs:
             self._ex.shutdown(wait=False)
         return out
 
     def close(self):
-        """Release the worker even when the consumer loop unwinds early
-        (fn raised mid-batch) — otherwise the in-flight prepare keeps
-        reading/transferring and the non-daemon thread lingers."""
-        if self._next is not None:
-            self._next.cancel()
-        self._ex.shutdown(wait=False)
+        """Release the pool even when the consumer loop unwinds early
+        (fn raised mid-batch) — queued prepares are cancelled and the
+        non-daemon workers exit as soon as any in-flight prepare
+        finishes, so nothing lingers reading/transferring."""
+        for f in self._futs:
+            f.cancel()
+        self._futs.clear()
+        self._ex.shutdown(wait=False, cancel_futures=True)
+
+
+def _is_device_fn(fn) -> bool:
+    """Jitted/device-fn detection: any ``jax.stages.Wrapped`` (jit,
+    pjit, AOT wrappers) counts, plus the legacy ``lower`` probe for
+    compiled executables. A plain-python wrapper AROUND a jitted call
+    is still undetectable — ``map_batches(device_fn=True)`` is the
+    explicit override (and the executor warns once when outputs come
+    back as device arrays anyway)."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            if isinstance(fn, jax.stages.Wrapped):
+                return True
+        except Exception:  # pragma: no cover - jax API drift
+            pass
+    return hasattr(fn, "lower")
+
+
+_warned_device_outputs = False
+
+
+def _warn_device_outputs_once():
+    global _warned_device_outputs
+    if _warned_device_outputs:
+        return
+    _warned_device_outputs = True
+    warnings.warn(
+        "map_batches classified fn as a HOST function (prefetch and "
+        "fused dispatch disabled) but its outputs are device arrays — "
+        "fn likely wraps a jitted call the heuristic cannot see. Pass "
+        "device_fn=True (or prefetch=True) to enable the pipelined "
+        "executor.", RuntimeWarning, stacklevel=3)
+
+
+def _fused_wrapper(fn: Callable, m: int) -> Callable:
+    """ONE compiled program that runs ``m`` microbatches per dispatch:
+    inputs are stacked (m, B, ...), a ``lax.scan`` applies ``fn`` to
+    each microbatch on-device, outputs come back flattened (m·B, ...).
+    The tunnel pays one dispatch round-trip per m batches instead of
+    per batch — the 485 vs 7,472 img/s gap in PROFILE.md is almost
+    entirely that per-step round-trip (GPipe-style multi-step fusion,
+    Huang et al. 2019).
+
+    The wrapper is cached ON fn itself (``fn._tpudl_fused[m]``): the
+    fused program — whose closure pins fn and, transitively, its model
+    weights — then lives exactly as long as fn does; the fn↔wrapper
+    reference cycle is an ordinary gc-collectible cycle, so a discarded
+    transformer frees both (a module-level cache keyed by fn would keep
+    the pair alive forever: the wrapper's closure references its own
+    key)."""
+    per_fn = getattr(fn, "_tpudl_fused", None)
+    if per_fn is not None and int(m) in per_fn:
+        return per_fn[int(m)]
+    import jax
+
+    @jax.jit
+    def fused(*stacked):
+        def body(carry, xs):
+            r = fn(*xs)
+            if not isinstance(r, (tuple, list)):
+                r = (r,)
+            return carry, tuple(r)
+
+        _, ys = jax.lax.scan(body, None, tuple(stacked))
+        return tuple(
+            y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:]) for y in ys)
+
+    try:
+        if per_fn is None:
+            per_fn = fn._tpudl_fused = {}
+        per_fn[int(m)] = fused
+    except (AttributeError, TypeError):  # fn rejects attributes: uncached
+        pass
+    return fused
 
 
 def _as_column(values) -> np.ndarray:
@@ -288,6 +406,10 @@ class Frame:
         pack: Callable | None = None,
         check_finite: bool = False,
         prefetch: bool | None = None,
+        prefetch_depth: int | None = None,
+        prepare_workers: int | None = None,
+        fuse_steps: int | None = None,
+        device_fn: bool | None = None,
     ) -> "Frame":
         """Run ``fn`` over the frame in device-sized batches; append outputs.
 
@@ -303,27 +425,68 @@ class Frame:
         (``ceil(rows / num_partitions)`` — the Spark-side meaning of a
         partition as the unit of executor dispatch), else 256.
 
-        ``prefetch`` enables the double-buffered infeed (SURVEY.md §7.3):
-        a one-deep worker thread packs AND host→device-transfers batch
-        k+1 while batch k computes, so decode/stack work and the wire
-        transfer ride under device compute instead of serializing with
-        it. Default: on when ``fn`` is a jitted/device function (or a
-        mesh is given), off for plain host fns (whose inputs must stay
-        numpy). NOTE the jitted-fn detection is a heuristic
-        (``hasattr(fn, "lower")``): a plain-python wrapper around a
-        jitted call is NOT detected — pass ``prefetch=True`` explicitly
-        there. ``TPUDL_FRAME_PREFETCH=0`` force-disables (bench A/B).
+        The executor is a staged pipeline (PIPELINE.md has the stage-time
+        model; every stage reports into ``tpudl.obs.last_pipeline_report``):
+
+        1. ``prepare`` pool — up to ``prepare_workers`` threads
+           (``TPUDL_FRAME_PREPARE_WORKERS``, default 2) pack/decode
+           batches concurrently, so a 256-image PIL decode no longer
+           serializes with compute;
+        2. a ``prefetch_depth``-deep bounded infeed queue
+           (``TPUDL_FRAME_PREFETCH_DEPTH``, default 2) — host RAM stays
+           O(depth · batch);
+        3. multi-step fused dispatch — when ``fn`` is a jitted device fn,
+           ``mesh`` is None and batches are full-size, ``fuse_steps``
+           (``TPUDL_FRAME_FUSE_STEPS``, default 1 = off) microbatches are
+           stacked and executed by ONE compiled ``lax.scan`` program, so
+           a tunneled backend pays one dispatch round-trip per M batches
+           (the per-step dispatch latency is ~93% of wall time on the
+           judged config, PROFILE.md);
+        4. the windowed/accumulated async outfeed (unchanged).
+
+        ``prefetch`` defaults to on for device fns, off for host fns
+        (whose inputs must stay numpy). ``device_fn`` overrides the
+        detection — the heuristic recognizes ``jax.stages.Wrapped``
+        (jit/pjit) and ``.lower()``-bearing executables, but NOT a
+        plain-python wrapper around a jitted call; the executor warns
+        once when a "host" fn returns device arrays.
+        ``TPUDL_FRAME_PREFETCH=0`` force-disables the whole pipelined
+        executor — prefetch AND fusion — for the bench A/B arm.
         """
         if batch_size is None:
             if self.num_partitions:
                 batch_size = max(1, -(-self._n // int(self.num_partitions)))
             else:
                 batch_size = 256
-        device_fn = mesh is not None or hasattr(fn, "lower")  # jitted?
+        heuristic = device_fn is None
+        device_flag = ((mesh is not None or _is_device_fn(fn))
+                       if heuristic else bool(device_fn))
         if prefetch is None:
-            prefetch = device_fn
-        if os.environ.get("TPUDL_FRAME_PREFETCH", "1") == "0":
+            prefetch = device_flag
+        killed = os.environ.get("TPUDL_FRAME_PREFETCH", "1") == "0"
+        if killed:
             prefetch = False
+        depth = (int(prefetch_depth) if prefetch_depth is not None
+                 else _env_int("TPUDL_FRAME_PREFETCH_DEPTH", 2))
+        workers = (int(prepare_workers) if prepare_workers is not None
+                   else _env_int("TPUDL_FRAME_PREPARE_WORKERS", 2))
+        if (prepare_workers is None
+                and "TPUDL_FRAME_PREPARE_WORKERS" not in os.environ
+                and pack is not None
+                and not getattr(pack, "thread_safe", False)):
+            # a user-supplied pack never promised thread-safety (same
+            # contract as LazyFileColumn's decode_workers=1 default):
+            # run it single-worker unless the caller opted in — via the
+            # kwarg/env, or by marking the callable ``pack.thread_safe
+            # = True`` (the first-party packs are marked)
+            workers = 1
+        fuse = max(1, (int(fuse_steps) if fuse_steps is not None
+                       else _env_int("TPUDL_FRAME_FUSE_STEPS", 1)))
+        if killed or mesh is not None or not device_flag:
+            # fusion stacks unsharded host batches into one jittable
+            # program: it needs a device fn and no mesh sharding, and the
+            # A/B kill switch must yield the plain serial executor
+            fuse = 1
         if mesh is not None:
             from tpudl import mesh as M  # jax import only on the mesh path
 
@@ -332,92 +495,165 @@ class Frame:
         if missing:
             raise KeyError(f"unknown input columns {missing}")
 
+        from tpudl import obs  # deferred: host-only frames stay light
+
+        report = obs.PipelineReport()
+        report.config = {
+            "executor": ("pipelined" if (prefetch or fuse > 1)
+                         else "serial"),
+            "prefetch": bool(prefetch),
+            "prefetch_depth": int(depth) if prefetch else 0,
+            "prepare_workers": (max(1, min(workers, depth))
+                                if prefetch else 0),
+            "fuse_steps": fuse,
+            "batch_size": int(batch_size),
+            "rows": self._n,
+        }
+        obs.set_last_pipeline(report)
+
         def prepare(start, stop):
             """Pack (and, on the prefetch path, transfer) one batch.
-            Runs on the worker thread when prefetching: jax dispatch is
-            thread-safe and transfers release the GIL, so this overlaps
-            the main thread's compute dispatch."""
-            packed = []
-            for c in input_cols:
-                sl = self._cols[c][start:stop]
-                arr = pack(sl) if pack is not None else _default_pack(sl)
-                if check_finite and np.issubdtype(arr.dtype, np.floating):
-                    # input-pipeline sanitizer (SURVEY.md §5.2): catch bad
-                    # rows host-side before they enter a fused program
-                    bad = ~np.isfinite(arr).reshape(arr.shape[0], -1).all(1)
-                    if bad.any():
-                        rows = (np.nonzero(bad)[0][:8] + start).tolist()
-                        raise ValueError(
-                            f"non-finite values in column {c!r}, rows "
-                            f"{rows} (batch {start}:{stop})")
-                packed.append(arr)
-            n_pad = 0
-            if mesh is not None:
-                # every column slices the same rows, so one pad count serves
-                padded = [M.pad_batch(arr, multiple) for arr in packed]
-                n_pad = padded[0][1] if padded else 0
-                packed = [M.shard_batch(p, mesh) for p, _ in padded]
-                if prefetch:
-                    import jax
+            Runs on a prepare-pool thread when prefetching: jax dispatch
+            is thread-safe and transfers release the GIL, so this
+            overlaps the main thread's compute dispatch. The pool runs
+            ``pack`` for DIFFERENT batches concurrently only when the
+            pack opted in (see the workers resolution above)."""
+            with report.stage("prepare"):
+                packed = []
+                for c in input_cols:
+                    sl = self._cols[c][start:stop]
+                    arr = pack(sl) if pack is not None else _default_pack(sl)
+                    if check_finite and np.issubdtype(arr.dtype, np.floating):
+                        # input-pipeline sanitizer (SURVEY.md §5.2): catch
+                        # bad rows host-side before they enter a fused
+                        # program
+                        bad = ~np.isfinite(arr).reshape(arr.shape[0], -1).all(1)
+                        if bad.any():
+                            rows = (np.nonzero(bad)[0][:8] + start).tolist()
+                            raise ValueError(
+                                f"non-finite values in column {c!r}, rows "
+                                f"{rows} (batch {start}:{stop})")
+                    packed.append(arr)
+                n_pad = 0
+                if mesh is not None:
+                    # every column slices the same rows, so one pad count
+                    # serves
+                    with report.stage("h2d"):
+                        padded = [M.pad_batch(arr, multiple) for arr in packed]
+                        n_pad = padded[0][1] if padded else 0
+                        packed = [M.shard_batch(p, mesh) for p, _ in padded]
+                        if prefetch:
+                            import jax
 
-                    jax.block_until_ready(packed)  # force the copy HERE
-            # mesh=None: host arrays go straight into the jitted fn even
-            # when prefetching — the runtime's own arg transfer pipelines
-            # far better than an explicit device_put on tunneled/remote
-            # backends (measured: prefetch-with-device_put was SLOWER
-            # than the serial fn-arg route through the tunnel). The
-            # prefetch win here is the pack/decode work riding under
-            # compute; the transfer stays on the dispatch path.
-            return packed, n_pad
+                            jax.block_until_ready(packed)  # the copy, HERE
+                # mesh=None: host arrays go straight into the jitted fn even
+                # when prefetching — the runtime's own arg transfer pipelines
+                # far better than an explicit device_put on tunneled/remote
+                # backends (measured: prefetch-with-device_put was SLOWER
+                # than the serial fn-arg route through the tunnel). The
+                # prefetch win here is the pack/decode work riding under
+                # compute; the transfer stays on the dispatch path (so
+                # ``h2d`` shows up inside ``dispatch`` on this path).
+                return packed, n_pad
 
         outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
         acc: list[list] = [[] for _ in output_cols]  # device-resident results
-        segs: list[tuple[int, int]] = []  # (padded_len, n_pad) per batch
+        segs: list[tuple[int, int]] = []  # (padded_len, n_pad) per dispatch
         pending: list[tuple[tuple, int]] = []
         mode = None  # "acc" (fetch once at end) or "window" (bounded drain)
-        est_batches = max(1, -(-self._n // max(1, batch_size)))
-        spans = list(self.iter_batches(batch_size))
-        infeed = _PrefetchInfeed(prepare, spans) if prefetch else None
-        try:
-            for bi, (start, stop) in enumerate(spans):
-                packed, n_pad = (infeed.get(bi) if infeed
-                                 else prepare(start, stop))
-                result = fn(*packed)
-                if not isinstance(result, (tuple, list)):
-                    result = (result,)
-                if len(result) != len(output_cols):
-                    raise ValueError(
-                        f"fn returned {len(result)} outputs, expected "
-                        f"{len(output_cols)}")
-                if mode is None:
-                    mode = _pick_fetch_mode(result, est_batches)
-                if mode == "acc":
-                    # Keep results device-resident and fetch ONCE per column
-                    # at the end: device→host fetch has a large fixed cost
-                    # per round-trip on tunneled/remote PJRT backends, so
-                    # per-batch fetching serializes the pipeline (round-1
-                    # bottleneck).
-                    for i, r in enumerate(result):
-                        acc[i].append(r)
-                    segs.append((stop - start + n_pad, n_pad))
-                else:
-                    # Large outputs (e.g. outputMode='image'): bounded
-                    # window so device memory stays O(window · batch), with
-                    # the host copy started at dispatch so it overlaps later
-                    # batches' compute.
-                    for r in result:
-                        if hasattr(r, "copy_to_host_async"):
-                            r.copy_to_host_async()
-                    pending.append((tuple(result), n_pad))
-                    if len(pending) > _PIPELINE_WINDOW:
+
+        def handle(result, n_pad):
+            """Route one dispatch's result into the outfeed (acc/window)."""
+            nonlocal mode, fuse
+            if not isinstance(result, (tuple, list)):
+                result = (result,)
+            if len(result) != len(output_cols):
+                raise ValueError(
+                    f"fn returned {len(result)} outputs, expected "
+                    f"{len(output_cols)}")
+            if mode is None:
+                if (heuristic and not device_flag and all(
+                        hasattr(r, "copy_to_host_async") for r in result)):
+                    _warn_device_outputs_once()
+                mode = _pick_fetch_mode(result, max(1, self._n))
+                if mode == "window" and fuse > 1:
+                    # window mode exists to bound device memory at
+                    # O(window · batch); a fused entry holds fuse× that,
+                    # so big-output runs fall back to per-batch dispatch
+                    fuse = 1
+            if mode == "acc":
+                # Keep results device-resident and fetch ONCE per column
+                # at the end: device→host fetch has a large fixed cost
+                # per round-trip on tunneled/remote PJRT backends, so
+                # per-batch fetching serializes the pipeline (round-1
+                # bottleneck).
+                for i, r in enumerate(result):
+                    acc[i].append(r)
+                segs.append((int(result[0].shape[0]), n_pad))
+            else:
+                # Large outputs (e.g. outputMode='image'): bounded
+                # window so device memory stays O(window · batch), with
+                # the host copy started at dispatch so it overlaps later
+                # batches' compute.
+                for r in result:
+                    if hasattr(r, "copy_to_host_async"):
+                        r.copy_to_host_async()
+                pending.append((tuple(result), n_pad))
+                if len(pending) > _PIPELINE_WINDOW:
+                    with report.stage("d2h"):
                         _drain(pending.pop(0), outputs)
+
+        spans = list(self.iter_batches(batch_size))
+        # only the leading run of full-size batches is fusable (the
+        # ragged tail would change the compiled (m, B, ...) signature)
+        n_full = sum(1 for s, e in spans if e - s == batch_size)
+        infeed = (_PipelineInfeed(prepare, spans, depth, workers, report)
+                  if prefetch else None)
+        consumed = 0
+
+        def next_prepared():
+            nonlocal consumed
+            out = (infeed.get(consumed) if infeed
+                   else prepare(*spans[consumed]))
+            consumed += 1
+            return out
+
+        t_wall = time.perf_counter()
+        try:
+            while consumed < len(spans):
+                if fuse > 1 and consumed + fuse <= n_full:
+                    group = [next_prepared() for _ in range(fuse)]
+                    try:
+                        stacked = [np.stack([g[0][j] for g in group])
+                                   for j in range(len(input_cols))]
+                    except ValueError:
+                        # shapes drifted between microbatches (variable-
+                        # geometry pack): dispatch this group per-batch
+                        for packed, n_pad in group:
+                            with report.stage("dispatch"):
+                                result = fn(*packed)
+                            handle(result, n_pad)
+                        continue
+                    fused_fn = _fused_wrapper(fn, fuse)
+                    with report.stage("dispatch"):
+                        result = fused_fn(*stacked)
+                    report.count("fused_dispatches")
+                    handle(result, 0)
+                else:
+                    packed, n_pad = next_prepared()
+                    with report.stage("dispatch"):
+                        result = fn(*packed)
+                    handle(result, n_pad)
         finally:
             if infeed is not None:
                 infeed.close()
         while pending:
-            _drain(pending.pop(0), outputs)
+            with report.stage("d2h"):
+                _drain(pending.pop(0), outputs)
         if mode == "acc":
-            _fetch_accumulated(acc, segs, outputs)
+            with report.stage("d2h"):
+                _fetch_accumulated(acc, segs, outputs)
+        report.wall_seconds = time.perf_counter() - t_wall
         out = self
         for name, chunks in zip(output_cols, outputs):
             col = np.concatenate(chunks, axis=0) if chunks else np.empty((0,))
@@ -433,13 +669,16 @@ _PIPELINE_WINDOW = 2  # in-flight device batches retained before fetch
 _ACC_FETCH_CAP = 512 * 1024 * 1024  # max bytes held on device in "acc" mode
 
 
-def _pick_fetch_mode(result, est_batches: int) -> str:
+def _pick_fetch_mode(result, est_total_rows: int) -> str:
     """Device-resident accumulation for small outputs (features, scores),
-    windowed drain for big ones (image-sized tensors) or host results."""
+    windowed drain for big ones (image-sized tensors) or host results.
+    Sized per ROW (not per dispatch) so fused multi-step dispatches —
+    whose results are fuse_steps× bigger — estimate the same total."""
     if not all(hasattr(r, "copy_to_host_async") for r in result):
         return "window"  # fn returned host arrays; drain is free
-    per_batch = sum(r.nbytes for r in result)
-    return "acc" if per_batch * est_batches <= _ACC_FETCH_CAP else "window"
+    rows = max(1, int(result[0].shape[0]) if result[0].ndim else 1)
+    per_row = sum(r.nbytes for r in result) / rows
+    return "acc" if per_row * est_total_rows <= _ACC_FETCH_CAP else "window"
 
 
 def _fetch_accumulated(acc, segs, outputs):

@@ -251,6 +251,12 @@ def default_decode(raw_bytes: bytes, origin: str = "") -> dict | None:
     return PIL_decode(raw_bytes, origin=origin)
 
 
+# stateless decode over thread-safe substrates (PIL and the native
+# threaded decoder both release the GIL): LazyFileColumn may run it for
+# several batches concurrently under the executor's prepare pool
+default_decode.thread_safe = True
+
+
 def default_probe(raw_bytes: bytes) -> bool:
     """Cheap validity twin of :func:`default_decode`/:func:`PIL_decode`:
     header parse + stream verify (PIL ``Image.verify`` — no IDCT, no
@@ -272,19 +278,45 @@ def default_probe(raw_bytes: bytes) -> bool:
         return False
 
 
-def createNativeImageLoader(height: int, width: int, scale: float = 1.0):
+def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
+                            n_threads: int | None = None):
     """Build a URI→ndarray ``imageLoader`` (float32 RGB, values in
     [0, 255]·scale) whose ``batch_decode`` attribute routes a WHOLE URI
     batch through one threaded native decode+resize call — the pack-stage
     fast path ``load_uri_batch`` uses for
     KerasImageFileTransformer/Estimator. Per-URI calls and non-JPEG files
     fall back to PIL; a file failing both raises (the estimator path's
-    strictness)."""
+    strictness).
+
+    ``n_threads`` (env ``TPUDL_DECODE_THREADS``; default: native layer
+    picks min(batch, cpu_count)) caps the native decoder's thread count
+    per batch — set it low when several prepare-pool workers decode
+    concurrently so the pools don't oversubscribe the host. The file
+    reads feeding ``batch_decode`` are fanned over a small thread pool
+    too (reads release the GIL); everything here is thread-safe, so
+    concurrent ``batch_decode`` calls from the executor's prepare
+    workers are fine."""
+    if n_threads is None:
+        env = os.environ.get("TPUDL_DECODE_THREADS")
+        try:
+            n_threads = max(1, int(env)) if env else None
+        except ValueError:
+            n_threads = None  # malformed env: let the native layer pick
 
     def _pil_one(uri: str) -> np.ndarray:
         img = Image.open(uri).convert("RGB").resize(
             (width, height), Image.BILINEAR)
         return np.asarray(img, np.float32) * scale
+
+    def _read_all(uris: list) -> list:
+        def _read(u):
+            with open(u, "rb") as f:
+                return f.read()
+
+        return _parallel_map(
+            _read, uris,
+            _env_workers("TPUDL_FRAME_IO_WORKERS",
+                         LazyFileColumn._IO_WORKERS))
 
     def loader(uri: str) -> np.ndarray:
         from tpudl import native
@@ -306,11 +338,9 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0):
             return np.zeros((0, height, width, 3), np.float32)
         if not native.available():
             return np.stack([_pil_one(u) for u in uris])
-        raws = []
-        for u in uris:
-            with open(u, "rb") as f:
-                raws.append(f.read())
-        batch, ok = native.decode_resize_batch(raws, height, width)
+        raws = _read_all(uris)
+        batch, ok = native.decode_resize_batch(raws, height, width,
+                                               n_threads=n_threads)
         out = batch[:, :, :, ::-1].astype(np.float32) * scale
         for i, good in enumerate(ok):
             if not good:
@@ -318,6 +348,10 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0):
         return out
 
     loader.batch_decode = batch_decode
+    # stateless over thread-safe substrates (fresh buffers per call;
+    # libjpeg contexts are per-thread in decode.cpp): the executor's
+    # prepare pool may run batch_decode for several batches at once
+    loader.thread_safe = True
     return loader
 
 
@@ -373,10 +407,28 @@ class LazyFileColumn(LazyColumn):
     so host RAM is O(batch) at any dataset size — the streaming rebuild of
     the reference's lazy/partitioned ``sc.binaryFiles`` RDD (ref: sparkdl
     imageIO.py filesToDF ~L200). ``reads`` counts file reads, so tests can
-    assert laziness directly."""
+    assert laziness directly.
+
+    Worker knobs (the ``map_batches`` prepare pool calls ``_get`` for
+    DIFFERENT batches concurrently, so everything here is thread-safe):
+
+    - ``io_workers`` (env ``TPUDL_FRAME_IO_WORKERS``, default 8):
+      parallel file reads per batch — reads release the GIL;
+    - ``decode_workers`` (env ``TPUDL_FRAME_DECODE_WORKERS``, default
+      1): parallel per-row ``transform`` calls within one batch. The
+      default keeps the documented serial execution for user decoders
+      that never promised thread-safety — including ACROSS batches: the
+      executor's prepare pool calls ``_get`` for different batches
+      concurrently, so an unmarked transform runs under a column-wide
+      lock. A transform carrying ``thread_safe = True``
+      (``default_decode`` is marked — PIL and the native decoder both
+      release the GIL) or an explicit ``decode_workers > 1`` opts into
+      concurrency."""
 
     def __init__(self, paths, transform: Callable | None = None,
-                 probe: Callable | None = None):
+                 probe: Callable | None = None,
+                 io_workers: int | None = None,
+                 decode_workers: int | None = None):
         import threading
 
         self._paths = np.asarray(list(paths), dtype=object)
@@ -386,6 +438,14 @@ class LazyFileColumn(LazyColumn):
         self._memo: tuple[bytes, np.ndarray] | None = None
         self.reads = 0
         self._reads_lock = threading.Lock()  # parallel batch reads
+        self._memo_lock = threading.Lock()   # concurrent _get callers
+        self._transform_lock = threading.Lock()  # serial-decode contract
+        self.io_workers = int(io_workers if io_workers is not None
+                              else _env_workers("TPUDL_FRAME_IO_WORKERS",
+                                                self._IO_WORKERS))
+        self.decode_workers = int(
+            decode_workers if decode_workers is not None
+            else _env_workers("TPUDL_FRAME_DECODE_WORKERS", 1))
 
     _IO_WORKERS = 8  # parallel reads per batch; file IO releases the GIL
 
@@ -400,35 +460,48 @@ class LazyFileColumn(LazyColumn):
         return raw
 
     def _read_batch(self, indices: np.ndarray) -> list[bytes]:
-        if len(indices) >= 4:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(self._IO_WORKERS) as ex:
-                return list(ex.map(self._read_raw, indices))
-        return [self._read_raw(i) for i in indices]
+        return _parallel_map(self._read_raw, indices, self.io_workers)
 
     # memo only SMALL accesses (head()/limit()/collect-after-head reuse);
     # executor-sized map batches skip it, so no batch of decoded images
     # stays pinned in host RAM after a pipeline finishes
     _MEMO_MAX_ROWS = 32
 
+    def _decode_batch(self, indices: np.ndarray, raws: list) -> np.ndarray:
+        """Batched decode: per-row ``transform`` over the read bytes,
+        in row order. A transform that opted into concurrency (marked
+        ``thread_safe`` or explicit ``decode_workers > 1``) fans rows
+        over a thread pool (order preserved via ``ex.map``) and may run
+        for several batches at once under the executor's prepare pool;
+        otherwise the column-wide lock keeps the documented serial
+        execution even across concurrently-prepared batches."""
+        out = np.empty(len(indices), dtype=object)
+        if self._transform is None:
+            out[:] = raws
+            return out
+        row = lambda ir: self._transform(self._paths[ir[0]], ir[1])  # noqa: E731
+        if (getattr(self._transform, "thread_safe", False)
+                or self.decode_workers > 1):
+            out[:] = _parallel_map(row, zip(indices, raws),
+                                   self.decode_workers)
+            return out
+        with self._transform_lock:
+            out[:] = [row(ir) for ir in zip(indices, raws)]
+        return out
+
     def _get(self, indices: np.ndarray) -> np.ndarray:
         # Small-access memo: re-requesting the SAME index set returns the
         # decoded payloads without touching disk.
         key = indices.tobytes()
-        if self._memo is not None and self._memo[0] == key:
-            return _copy_rows(self._memo[1])
-        # Only the file READS are parallel (they release the GIL); the
-        # user-supplied transform (readImagesWithCustomFn's decode_f)
-        # keeps its documented sequential, in-order execution — callers
-        # never promised a thread-safe decoder.
+        with self._memo_lock:
+            memo = self._memo
+        if memo is not None and memo[0] == key:
+            return _copy_rows(memo[1])
         raws = self._read_batch(indices)
-        out = np.empty(len(indices), dtype=object)
-        for j, (i, raw) in enumerate(zip(indices, raws)):
-            out[j] = (self._transform(self._paths[i], raw)
-                      if self._transform else raw)
+        out = self._decode_batch(indices, raws)
         if len(indices) <= self._MEMO_MAX_ROWS:
-            self._memo = (key, out)
+            with self._memo_lock:
+                self._memo = (key, out)
             return _copy_rows(out)
         return out
 
@@ -462,7 +535,28 @@ class LazyFileColumn(LazyColumn):
         derives its lazy decoded column from filesToFrame's byte column
         without re-listing or re-sharding. ``probe`` (optional) is the
         transform's cheap validity twin used by :meth:`validity_mask`."""
-        return LazyFileColumn(self._paths, transform, probe=probe)
+        return LazyFileColumn(self._paths, transform, probe=probe,
+                              io_workers=self.io_workers,
+                              decode_workers=self.decode_workers)
+
+
+def _env_workers(name: str, default: int) -> int:
+    from tpudl.frame.frame import _env_int  # the one env-int parser
+
+    return max(1, _env_int(name, default))
+
+
+def _parallel_map(fn, items, workers: int) -> list:
+    """Order-preserving map, fanned over a thread pool when both the
+    item count (≥4) and ``workers`` (>1) justify one — the ONE
+    implementation behind batch file reads and batched decodes."""
+    items = list(items)
+    if len(items) >= 4 and workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(min(workers, len(items))) as ex:
+            return list(ex.map(fn, items))
+    return [fn(i) for i in items]
 
 
 def _copy_rows(arr: np.ndarray) -> np.ndarray:
@@ -565,9 +659,12 @@ def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None,
     files = filesToFrame(path, numPartitions=numPartition,
                          host_sharded=host_sharded, lazy=lazy)
     if lazy:
+        tr = lambda p, raw: _decode_row(decode_f, p, raw)  # noqa: E731
+        # the serial-decode contract follows decode_f's own declaration
+        # (default_decode is marked; custom decoders stay serialized)
+        tr.thread_safe = bool(getattr(decode_f, "thread_safe", False))
         col = files["fileData"].with_transform(
-            lambda p, raw: _decode_row(decode_f, p, raw),
-            probe=(lambda p, raw: probe_f(raw)) if probe_f else None)
+            tr, probe=(lambda p, raw: probe_f(raw)) if probe_f else None)
         return Frame({"image": col}, num_partitions=numPartition)
     structs = [_decode_row(decode_f, origin, raw)
                for origin, raw in zip(files["filePath"], files["fileData"])]

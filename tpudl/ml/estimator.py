@@ -73,11 +73,17 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, labelCol=None,
                  imageLoader=None, modelFile=None, kerasOptimizer=None,
-                 kerasLoss=None, kerasFitParams=None, mesh=None):
+                 kerasLoss=None, kerasFitParams=None, mesh=None,
+                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None):
         super().__init__()
         self._setDefault(kerasFitParams={"batch_size": 32, "epochs": 1,
                                          "verbose": 0})
         self.mesh = mesh
+        # pipelined-executor knobs, inherited by every transformer this
+        # estimator returns (fit -> KerasImageFileTransformer)
+        self.prefetchDepth = prefetchDepth
+        self.prepareWorkers = prepareWorkers
+        self.fuseSteps = fuseSteps
         self._save_lock = threading.Lock()  # shared keras write-back
         # one compiled train step per (ingested graph, loss, optimizer),
         # shared across every trial (learning rate is dynamic in opt_state,
@@ -88,6 +94,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         self._step_lock = threading.Lock()
         kwargs = dict(self._input_kwargs)
         kwargs.pop("mesh", None)
+        for k in ("prefetchDepth", "prepareWorkers", "fuseSteps"):
+            kwargs.pop(k, None)
         self._set(**kwargs)
 
     # -- validation (ref: _validateFitParams) ------------------------------
@@ -242,7 +250,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         return KerasImageFileTransformer(
             inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
             modelFile=model_path, imageLoader=self.getImageLoader(),
-            mesh=self.mesh)
+            mesh=self.mesh, prefetchDepth=self.prefetchDepth,
+            prepareWorkers=self.prepareWorkers, fuseSteps=self.fuseSteps)
 
     # -- fit entry points --------------------------------------------------
     def _ingest(self):

@@ -29,7 +29,8 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
                  imageLoader=None, outputMode="vector", batchSize=64,
-                 mesh=None):
+                 mesh=None, prefetchDepth=None, prepareWorkers=None,
+                 fuseSteps=None):
         super().__init__()
         self._setDefault(outputMode="vector")
         self.batchSize = int(batchSize)
@@ -37,6 +38,7 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         kwargs = dict(self._input_kwargs)
         kwargs.pop("batchSize", None)
         kwargs.pop("mesh", None)
+        self._set_pipeline_opts(kwargs)
         self._set(**kwargs)
 
     def _transform(self, frame):
@@ -48,6 +50,14 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
             from tpudl.ml.image_params import load_uri_batch
 
             return load_uri_batch(loader, sl)
+
+        # concurrency is strictly opt-in (the LazyFileColumn contract):
+        # only a loader that DECLARES itself thread-safe lets the
+        # prepare pool parallelize this pack — createNativeImageLoader
+        # is marked; custom loaders (batch_decode or per-URI) keep the
+        # safe single-worker default unless marked or prepareWorkers is
+        # set explicitly
+        pack.thread_safe = bool(getattr(loader, "thread_safe", False))
 
         def build():
             from tpudl.ingest import TFInputGraph
@@ -69,7 +79,8 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
             (model_file, os.path.getmtime(model_file), mode), build)
         out = frame.map_batches(
             jfn, [self.getInputCol()], [out_col],
-            batch_size=self.batchSize, mesh=self.mesh, pack=pack)
+            batch_size=self.batchSize, mesh=self.mesh, pack=pack,
+            **self._pipeline_opts())
         if mode == "image":
             structs = [
                 imageIO.imageArrayToStruct(np.asarray(a, dtype=np.float32))

@@ -21,13 +21,15 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
                        HasKerasModel):
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
-                 batchSize=256, mesh=None):
+                 batchSize=256, mesh=None, prefetchDepth=None,
+                 prepareWorkers=None, fuseSteps=None):
         super().__init__()
         self.batchSize = int(batchSize)
         self.mesh = mesh
         kwargs = dict(self._input_kwargs)
         kwargs.pop("batchSize", None)
         kwargs.pop("mesh", None)
+        self._set_pipeline_opts(kwargs)
         self._set(**kwargs)
 
     def _transform(self, frame):
@@ -43,5 +45,7 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
             tfInputGraph=gin,
             inputMapping={self.getInputCol(): gin.input_names[0]},
             outputMapping={gin.output_names[0]: self.getOutputCol()},
-            batchSize=self.batchSize, mesh=self.mesh)
+            batchSize=self.batchSize, mesh=self.mesh,
+            prefetchDepth=self.prefetchDepth,
+            prepareWorkers=self.prepareWorkers, fuseSteps=self.fuseSteps)
         return delegate.transform(frame)

@@ -169,7 +169,7 @@ class _NamedImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
         return frame.map_batches(
             jfn, [self.getInputCol()], [out_col],
             batch_size=self.batchSize, mesh=self.mesh,
-            pack=_pack_image_structs)
+            pack=_pack_image_structs, **self._pipeline_opts())
 
 
 class DeepImageFeaturizer(_NamedImageTransformer):
@@ -180,7 +180,8 @@ class DeepImageFeaturizer(_NamedImageTransformer):
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  weights="random", batchSize=64, mesh=None,
-                 computeDtype="float32"):
+                 computeDtype="float32", prefetchDepth=None,
+                 prepareWorkers=None, fuseSteps=None):
         super().__init__()
         self.weights = weights
         self.batchSize = int(batchSize)
@@ -189,6 +190,7 @@ class DeepImageFeaturizer(_NamedImageTransformer):
         kwargs = dict(self._input_kwargs)
         for k in ("weights", "batchSize", "mesh", "computeDtype"):
             kwargs.pop(k, None)
+        self._set_pipeline_opts(kwargs)
         self._set(**kwargs)
 
     def _head_fn(self, model, params):
@@ -212,7 +214,8 @@ class DeepImagePredictor(_NamedImageTransformer):
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  decodePredictions=False, topK=5, weights="random",
-                 batchSize=64, mesh=None, computeDtype="float32"):
+                 batchSize=64, mesh=None, computeDtype="float32",
+                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None):
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5)
         self.weights = weights
@@ -222,6 +225,7 @@ class DeepImagePredictor(_NamedImageTransformer):
         kwargs = dict(self._input_kwargs)
         for k in ("weights", "batchSize", "mesh", "computeDtype"):
             kwargs.pop(k, None)
+        self._set_pipeline_opts(kwargs)
         self._set(**kwargs)
 
     def _head_fn(self, model, params):

@@ -56,6 +56,27 @@ class Transformer(Params):
         cache[key] = fn
         return fn
 
+    def _pipeline_opts(self) -> dict:
+        """The ``Frame.map_batches`` pipelined-executor knobs every
+        batch transformer plumbs through: prefetch depth (K), prepare
+        workers (N), fused dispatch steps (M). None = resolve from the
+        ``TPUDL_FRAME_*`` env knobs / defaults inside map_batches, so a
+        transformer that never sets them still rides the pipeline."""
+        return {
+            "prefetch_depth": getattr(self, "prefetchDepth", None),
+            "prepare_workers": getattr(self, "prepareWorkers", None),
+            "fuse_steps": getattr(self, "fuseSteps", None),
+        }
+
+    def _set_pipeline_opts(self, kwargs: dict):
+        """Pop the pipeline knobs out of an ``_input_kwargs`` dict and
+        pin them as plain attributes (they parameterize the executor,
+        not the model — keeping them out of the Param map mirrors
+        batchSize/mesh)."""
+        self.prefetchDepth = kwargs.pop("prefetchDepth", None)
+        self.prepareWorkers = kwargs.pop("prepareWorkers", None)
+        self.fuseSteps = kwargs.pop("fuseSteps", None)
+
 
 class Model(Transformer):
     """A fitted Transformer (keeps Spark's Estimator→Model naming)."""

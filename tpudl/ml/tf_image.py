@@ -62,6 +62,23 @@ class ImageBatchWarmup:
             x, _ = M.pad_batch(x, self.mesh.shape[M.DATA_AXIS])
             x = M.shard_batch(x, self.mesh)
         jax.block_until_ready(jfn(x))  # compile + execute; never fetched
+        if self.mesh is None:
+            # the executor will run the FUSED multi-step program when
+            # fuse_steps > 1 — warm that compile here too (compiles
+            # don't fetch, and a mid-transform compile would land
+            # inside the timed window)
+            import os as _os
+
+            from tpudl.frame import frame as _frame
+
+            fuse = getattr(self, "fuseSteps", None)
+            if fuse is None:
+                fuse = _frame._env_int("TPUDL_FRAME_FUSE_STEPS", 1)
+            if (int(fuse) > 1
+                    and _os.environ.get("TPUDL_FRAME_PREFETCH", "1") != "0"):
+                fused = _frame._fused_wrapper(jfn, int(fuse))
+                xs = np.zeros((int(fuse),) + x.shape, dtype=dtype)
+                jax.block_until_ready(fused(xs))
         return self
 
 
@@ -93,7 +110,8 @@ class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, graph=None,
                  inputTensor=None, outputTensor=None, channelOrder="RGB",
-                 outputMode="vector", batchSize=64, mesh=None):
+                 outputMode="vector", batchSize=64, mesh=None,
+                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None):
         super().__init__()
         self._setDefault(channelOrder="RGB", outputMode="vector")
         self.batchSize = int(batchSize)
@@ -101,6 +119,7 @@ class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
         kwargs = dict(self._input_kwargs)
         kwargs.pop("batchSize", None)
         kwargs.pop("mesh", None)
+        self._set_pipeline_opts(kwargs)
         self.setParams(**kwargs)
 
     def setParams(self, **kwargs):
@@ -156,7 +175,8 @@ class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
         jfn = self._get_jfn()
         out = frame.map_batches(
             jfn, [in_col], [out_col], batch_size=self.batchSize,
-            mesh=self.mesh, pack=_pack_image_structs)
+            mesh=self.mesh, pack=_pack_image_structs,
+            **self._pipeline_opts())
         if mode == "image":
             structs = [
                 imageIO.imageArrayToStruct(np.asarray(a, dtype=np.float32))
@@ -186,3 +206,8 @@ def _pack_image_structs(sl: np.ndarray) -> np.ndarray:
             f"mixed image shapes {sorted(shapes)} in one column; resize "
             "first (imageIO.resizeImage / createResizeImageUDF)")
     return np.stack(arrays)
+
+
+# pure function of its slice: the executor's prepare pool may run it for
+# different batches concurrently (map_batches checks this marker)
+_pack_image_structs.thread_safe = True

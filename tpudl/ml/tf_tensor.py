@@ -27,13 +27,15 @@ class TFTransformer(Transformer):
 
     @keyword_only
     def __init__(self, *, tfInputGraph=None, inputMapping=None,
-                 outputMapping=None, batchSize=256, mesh=None):
+                 outputMapping=None, batchSize=256, mesh=None,
+                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None):
         super().__init__()
         self.batchSize = int(batchSize)
         self.mesh = mesh
         kwargs = dict(self._input_kwargs)
         kwargs.pop("batchSize", None)
         kwargs.pop("mesh", None)
+        self._set_pipeline_opts(kwargs)
         self._set(**kwargs)
 
     def setTfInputGraph(self, value):
@@ -74,4 +76,5 @@ class TFTransformer(Transformer):
         jfn = self._cached_jit(
             (gin, tuple(feeds), tuple(fetches)), build)
         return frame.map_batches(jfn, in_cols, out_cols,
-                                 batch_size=self.batchSize, mesh=self.mesh)
+                                 batch_size=self.batchSize, mesh=self.mesh,
+                                 **self._pipeline_opts())

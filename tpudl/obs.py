@@ -15,12 +15,12 @@ import glob
 import gzip
 import json
 import os
+import threading
 import time
 
-import jax
-
 __all__ = ["profile", "named_scope", "Meter", "load_trace_events",
-           "summarize_device_trace"]
+           "summarize_device_trace", "PipelineReport",
+           "last_pipeline_report", "set_last_pipeline"]
 
 
 @contextlib.contextmanager
@@ -29,6 +29,8 @@ def profile(log_dir: str):
     tensorboard-plugin-profile or xprof against ``log_dir``, or parse
     programmatically with :func:`load_trace_events` +
     :func:`summarize_device_trace`."""
+    import jax
+
     jax.profiler.start_trace(log_dir)
     try:
         yield
@@ -91,7 +93,112 @@ def summarize_device_trace(events: list[dict]) -> dict:
             "ops": ops}
 
 
-named_scope = jax.named_scope  # label pipeline stages inside jitted code
+def named_scope(name: str):
+    """Label pipeline stages inside jitted code (jax.named_scope; jax
+    imported lazily so host-only Frame pipelines — which report into
+    this module every map_batches call — never pay the jax import)."""
+    import jax
+
+    return jax.named_scope(name)
+
+
+class PipelineReport:
+    """Per-stage wall time + gauges for ONE ``Frame.map_batches`` run.
+
+    The stage-time model (PIPELINE.md has the reading guide):
+
+    - ``prepare``: worker-thread seconds in decode/pack (summed across
+      the prepare pool — N workers can make this exceed wall time);
+    - ``h2d``: the explicit shard + host→device transfer inside prepare
+      (mesh path only; on the mesh=None tunnel path the transfer rides
+      the dispatch, see map_batches);
+    - ``dispatch``: consumer-thread seconds in ``fn(...)`` — enqueue
+      only for async device fns, enqueue+compute for host fns;
+    - ``d2h``: device→host fetch time (windowed drain + the acc-mode
+      final fetch);
+    - ``infeed_wait``: consumer seconds blocked on the infeed queue —
+      the UNHIDDEN remainder of prepare, and the numerator of
+      ``overlap_efficiency``.
+
+    Gauges (``gauge``) keep every sample; the report surfaces mean/max
+    (``queue_depth`` is sampled at each consumer take: depth K means the
+    pool is keeping the device fed). Thread-safe: prepare workers and
+    the consumer thread write concurrently.
+    """
+
+    def __init__(self):
+        self.stages: dict[str, float] = {}
+        self.calls: dict[str, int] = {}
+        self.gauges: dict[str, list] = {}
+        self.wall_seconds = 0.0
+        self.config: dict = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float):
+        with self._lock:
+            self.stages[name] = self.stages.get(name, 0.0) + seconds
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def count(self, name: str, k: int = 1):
+        with self._lock:
+            self.calls[name] = self.calls.get(name, 0) + k
+
+    def gauge(self, name: str, value):
+        with self._lock:
+            self.gauges.setdefault(name, []).append(value)
+
+    def overlap_efficiency(self) -> float | None:
+        """Fraction of host prepare work hidden under device compute:
+        1 - infeed_wait/prepare, clamped to [0, 1]. 1.0 = the consumer
+        never waited (prepare fully overlapped); 0.0 = fully serial.
+        None when nothing was prepared (empty frame / no prefetch)."""
+        prep = self.stages.get("prepare", 0.0)
+        if prep <= 0.0:
+            return None
+        wait = self.stages.get("infeed_wait", 0.0)
+        return max(0.0, min(1.0, 1.0 - wait / prep))
+
+    def report(self) -> dict:
+        with self._lock:
+            out = {
+                "wall_seconds": round(self.wall_seconds, 4),
+                "stage_seconds": {k: round(v, 4)
+                                  for k, v in sorted(self.stages.items())},
+                "stage_calls": dict(sorted(self.calls.items())),
+            }
+            for name, vals in sorted(self.gauges.items()):
+                out[f"{name}_mean"] = round(sum(vals) / len(vals), 2)
+                out[f"{name}_max"] = max(vals)
+            out.update(self.config)
+        eff = self.overlap_efficiency()
+        if eff is not None:
+            out["overlap_efficiency"] = round(eff, 3)
+        return out
+
+
+_LAST_PIPELINE: PipelineReport | None = None
+
+
+def set_last_pipeline(report: PipelineReport | None):
+    """Filed by ``Frame.map_batches`` at the start of every run, so the
+    caller above any transformer stack (bench.py, a notebook) can read
+    the executor's stage breakdown without threading a handle through
+    the transformer APIs."""
+    global _LAST_PIPELINE
+    _LAST_PIPELINE = report
+
+
+def last_pipeline_report() -> dict | None:
+    """Stage breakdown of the most recent map_batches run (or None)."""
+    return _LAST_PIPELINE.report() if _LAST_PIPELINE is not None else None
 
 
 class Meter:

@@ -28,7 +28,10 @@ __all__ = ["makeGraphUDF"]
 def makeGraphUDF(graph, udf_name: str, fetches=None,
                  feeds_to_fields_map: dict[str, str] | None = None,
                  blocked: bool = True, register: bool = True, *,
-                 batch_size: int = 256, mesh=None) -> UDF:
+                 batch_size: int = 256, mesh=None,
+                 prefetch_depth: int | None = None,
+                 prepare_workers: int | None = None,
+                 fuse_steps: int | None = None) -> UDF:
     """Register ``graph`` as a SQL UDF named ``udf_name``.
 
     ``graph``: a :class:`tpudl.ingest.TFInputGraph` (any factory route,
@@ -39,6 +42,10 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
     ``feeds_to_fields_map`` maps graph input name → frame column name
     (default: the input's own op name). ``register=False`` builds and
     returns the UDF without filing it in the registry.
+    ``prefetch_depth`` / ``prepare_workers`` / ``fuse_steps`` plumb the
+    ``Frame.map_batches`` pipelined-executor knobs (None = the
+    ``TPUDL_FRAME_*`` env defaults), so SQL-registered models ride the
+    same staged pipeline as the ml transformers.
 
     SQL's ``fn(col)`` grammar binds single-input graphs; multi-input
     graphs still register and are callable as ``udf(frame)`` with every
@@ -95,7 +102,9 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
         # map_batches's default pack already stacks numeric and
         # object-of-array columns (frame._default_pack)
         return frame.map_batches(
-            jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh)
+            jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh,
+            prefetch_depth=prefetch_depth, prepare_workers=prepare_workers,
+            fuse_steps=fuse_steps)
 
     if register:
         return register_udf(udf_name, frame_fn, in_cols[0], out_col)
