@@ -324,6 +324,10 @@ def run_featurize_trial(arm, n, batch, dtype):
         # + queue-depth/overlap gauges — the judged record carries the
         # pipeline's own accounting of where the wall-clock went
         rec["pipeline"] = obs.last_pipeline_report()
+        # the process-wide registry snapshot rides along (files/bytes
+        # decoded, transformer rows, stage-second totals): the trial
+        # record carries the run's whole observability surface
+        rec["metrics"] = obs.snapshot()
     except Exception as e:
         log(f"pipeline report unavailable: {e!r}")
     try:
@@ -1543,6 +1547,14 @@ def main():
         except Exception as e:  # baseline failure must not kill the bench
             log(f"baseline measurement failed: {e!r}")
 
+    try:
+        from tpudl import obs as _obs
+
+        # the parent process's own registry snapshot (the subprocess
+        # trials carry theirs per-trial in featurize_streaming)
+        extra["metrics_snapshot"] = _obs.snapshot()
+    except Exception as e:
+        log(f"metrics snapshot unavailable: {e!r}")
     extra.setdefault("value", None)
     extra["vs_baseline"] = (round(extra["value"] / base["value"], 3)
                             if base and extra["value"] else None)

@@ -39,6 +39,44 @@ def full_record():
         return json.load(f)
 
 
+@pytest.fixture(scope="module")
+def validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_metrics", os.path.join(REPO, "tools",
+                                         "validate_metrics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_summary_passes_schema_validator(bench, full_record, validator):
+    """tools/validate_metrics.py is the one schema authority for the
+    judged last line — a drift in _compact_summary (nested objects,
+    missing judged keys, oversized line) fails tier-1 here instead of
+    surfacing as a driver parse failure."""
+    line = json.dumps(bench._compact_summary(full_record))
+    assert validator.validate_bench_summary_line(line) == []
+    # the watchdog/SIGTERM partial shape must validate too
+    partial = json.dumps(bench._compact_summary(
+        {"metric": "m", "value": None, "unit": "u", "vs_baseline": None,
+         "partial": True, "sigterm": True}))
+    assert validator.validate_bench_summary_line(partial) == []
+
+
+def test_trial_record_metrics_snapshot_validates(bench, validator):
+    """Streaming trial records embed the process-wide registry snapshot
+    (obs.snapshot()); every entry must satisfy the metric schema the
+    JSONL sink promises."""
+    from tpudl import obs
+
+    obs.counter("bench_contract.demo").inc(2)
+    obs.histogram("bench_contract.lat").observe(0.5)
+    snap = obs.snapshot()
+    errs = [e for name, entry in snap.items()
+            for e in validator.validate_metric_entry(name, entry)]
+    assert errs == [], errs[:5]
+
+
 def test_summary_fits_driver_tail(bench, full_record):
     s = bench._compact_summary(full_record)
     line = json.dumps(s)

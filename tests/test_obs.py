@@ -48,6 +48,11 @@ def test_profile_writes_trace(tmp_path):
 
     files = [os.path.join(r, f) for r, _d, fs in os.walk(d) for f in fs]
     assert files, "profiler produced no trace files"
+    # the capture window is recorded for window="profile" host exports
+    from tpudl import obs
+
+    w = obs.get_tracer().last_profile_window
+    assert w is not None and w[1] >= w[0]
 
 
 def test_summarize_device_trace():

@@ -1,0 +1,137 @@
+"""PipelineReport ring + bounded gauges (ISSUE 3 satellites 1–2).
+
+The old module-level ``_LAST_PIPELINE`` global meant two concurrent
+``Frame.map_batches`` runs (HPO trials in threads) clobbered each
+other's report mid-run; the ring keyed by run id keeps both. Gauges
+used to append every sample forever; now they keep a bounded ring plus
+running aggregates, so mean/max stay exact at O(cap) memory.
+"""
+
+import threading
+
+import numpy as np
+
+from tpudl import obs
+from tpudl.frame import Frame
+from tpudl.obs.pipeline import GAUGE_SAMPLE_CAP, PipelineReport
+
+
+class TestBoundedGauges:
+    def test_gauge_memory_bounded_aggregates_exact(self):
+        r = PipelineReport()
+        n = GAUGE_SAMPLE_CAP * 3
+        for i in range(n):
+            r.gauge("queue_depth", float(i))
+        ring = r.gauges["queue_depth"].samples
+        assert len(ring) == GAUGE_SAMPLE_CAP  # memory capped
+        rep = r.report()
+        # mean/max computed over ALL n samples, not just the ring
+        assert rep["queue_depth_max"] == float(n - 1)
+        assert rep["queue_depth_mean"] == round((n - 1) / 2, 2)
+
+    def test_small_gauge_unchanged(self):
+        r = PipelineReport()
+        for v in (1, 3, 2):
+            r.gauge("g", v)
+        rep = r.report()
+        assert rep["g_max"] == 3 and rep["g_mean"] == 2.0
+
+    def test_concurrent_gauge_writers(self):
+        r = PipelineReport()
+
+        def work():
+            for i in range(2000):
+                r.gauge("depth", i % 7)
+
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        g = r.gauges["depth"]
+        assert g.count == 8000
+        assert r.report()["depth_max"] == 6
+
+
+class TestReportRing:
+    def test_last_report_is_newest(self):
+        a, b = PipelineReport(), PipelineReport()
+        obs.set_last_pipeline(a)
+        obs.set_last_pipeline(b)
+        assert obs.last_pipeline_report()["run_id"] == b.run_id
+        assert obs.get_pipeline_report(a.run_id)["run_id"] == a.run_id
+
+    def test_ring_is_bounded(self):
+        first = PipelineReport()
+        obs.set_last_pipeline(first)
+        cap = obs.pipeline_reports.__globals__["_REPORTS"].maxlen
+        for _ in range(cap + 4):
+            obs.set_last_pipeline(PipelineReport())
+        assert len(obs.pipeline_reports()) == cap
+        assert obs.get_pipeline_report(first.run_id) is None  # evicted
+
+    def test_none_is_a_noop(self):
+        r = PipelineReport()
+        obs.set_last_pipeline(r)
+        obs.set_last_pipeline(None)
+        assert obs.last_pipeline_report()["run_id"] == r.run_id
+
+    def test_concurrent_map_batches_keep_both_reports(self):
+        """Satellite 1: two concurrent runs (the HPO-trials-in-threads
+        shape) must BOTH leave retrievable, internally-consistent
+        reports — the racy single global lost one mid-run."""
+        import time
+
+        barrier = threading.Barrier(2)
+        sizes = {"a": (96, 8), "b": (40, 4)}  # (rows, batch) per run
+        results: dict = {}
+
+        def run(tag):
+            rows, batch = sizes[tag]
+            x = np.arange(rows, dtype=np.float32)
+
+            def fn(b):
+                time.sleep(0.002)  # keep both runs genuinely in flight
+                return b * 2
+
+            barrier.wait()
+            out = Frame({"x": x}).map_batches(fn, ["x"], ["y"],
+                                              batch_size=batch)
+            results[tag] = np.asarray(list(out["y"]), np.float32)
+
+        ts = [threading.Thread(target=run, args=(tag,)) for tag in sizes]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for tag, (rows, batch) in sizes.items():
+            np.testing.assert_allclose(
+                results[tag], np.arange(rows, dtype=np.float32) * 2)
+        reports = obs.pipeline_reports().values()
+        by_rows = {r.get("rows"): r for r in reports}
+        for rows, batch in sizes.values():
+            rep = by_rows.get(rows)
+            assert rep is not None, (
+                f"report for the {rows}-row run was clobbered")
+            # internally consistent: every batch dispatched exactly once
+            assert rep["stage_calls"]["dispatch"] == rows // batch
+            assert rep["wall_seconds"] > 0.0
+
+    def test_finish_publishes_into_registry(self):
+        obs.get_registry().reset()
+        try:
+            x = np.arange(16, dtype=np.float32)
+            Frame({"x": x}).map_batches(lambda b: b, ["x"], ["y"],
+                                        batch_size=4)
+            rep = obs.last_pipeline_report()
+            assert rep["rows"] == 16
+            s = obs.snapshot()
+            assert s["frame.map_batches.runs"]["value"] == 1.0
+            assert s["frame.stage.prepare.seconds"]["value"] >= 0.0
+        finally:
+            obs.get_registry().reset()
+
+    def test_stage_spans_land_on_tracer_with_run_id(self):
+        r = PipelineReport()
+        with r.stage("prepare"):
+            pass
+        spans = [s for s in obs.get_tracer().spans()
+                 if s.name == "frame.prepare"
+                 and s.attrs and s.attrs.get("run") == r.run_id]
+        assert spans, "stage() did not record a tracer span"

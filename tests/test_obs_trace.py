@@ -1,0 +1,266 @@
+"""Trace parsing + host/device merge + the ``python -m tpudl.obs trace``
+CLI (ISSUE 3 tentpole pillar 1 merge path + satellite 3).
+
+Fixtures are synthetic trace-viewer dumps: gzipped JSON with TPU
+process/lane metadata exactly as the jax.profiler writes them, plus a
+CPU-only variant that must summarize to empty rather than crash.
+"""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpudl.obs import trace as T
+from tpudl.obs.tracer import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _device_events(base=1000.0):
+    """Synthetic TPU trace: 2 module executions + 3 op events + a host
+    process that must be ignored. Times in µs from ``base``."""
+    return [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "jit_step",
+         "ts": base, "dur": 50.0},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "jit_step",
+         "ts": base + 120.0, "dur": 60.0},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "fusion.1",
+         "ts": base, "dur": 30.0,
+         "args": {"hlo_category": "convolution fusion",
+                  "bytes_accessed": "100"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "fusion.1",
+         "ts": base + 120.0, "dur": 30.0,
+         "args": {"hlo_category": "convolution fusion",
+                  "bytes_accessed": "100"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "copy.2",
+         "ts": base + 150.0, "dur": 10.0,
+         "args": {"bytes_accessed": "0"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "jit_step",
+         "ts": base, "dur": 9e9},  # host lane: never counted
+    ]
+
+
+def _host_events(base=1000.0):
+    """Host spans on the tracer's export shape: prepare [0,100],
+    d2h [150,200] relative to ``base``."""
+    return [
+        {"ph": "M", "pid": 42, "name": "process_name",
+         "args": {"name": "tpudl host"}},
+        {"ph": "M", "pid": 42, "tid": 1, "name": "thread_name",
+         "args": {"name": "MainThread"}},
+        {"ph": "X", "pid": 42, "tid": 1, "name": "frame.prepare",
+         "ts": base, "dur": 100.0},
+        {"ph": "X", "pid": 42, "tid": 1, "name": "frame.d2h",
+         "ts": base + 150.0, "dur": 50.0},
+    ]
+
+
+def _write_device_gz(trace_dir, events, name="x.trace.json.gz"):
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, name)
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _write_host_json(trace_dir, events, name="y.host.trace.json"):
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, name)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+class TestTraceParsing:
+    def test_load_trace_events_reads_gzipped_fixture(self, tmp_path):
+        d = str(tmp_path)
+        _write_device_gz(d, _device_events())
+        events = T.load_trace_events(d)
+        s = T.summarize_device_trace(events)
+        assert s["module_us"] == 110.0 and s["module_count"] == 2
+        assert s["ops"]["fusion.1"]["us"] == 60.0
+        assert s["ops"]["fusion.1"]["count"] == 2
+        assert s["ops"]["fusion.1"]["bytes"] == 200
+        assert s["ops"]["copy.2"]["us"] == 10.0
+
+    def test_load_trace_events_picks_newest(self, tmp_path):
+        d = str(tmp_path)
+        old = _write_device_gz(d, [], name="old.trace.json.gz")
+        _write_device_gz(d, _device_events(), name="new.trace.json.gz")
+        os.utime(old, (1, 1))
+        assert T.summarize_device_trace(
+            T.load_trace_events(d))["module_count"] == 2
+
+    def test_load_trace_events_missing_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="trace.json.gz"):
+            T.load_trace_events(str(tmp_path / "empty"))
+
+    def test_cpu_only_trace_summarizes_empty(self, tmp_path):
+        d = str(tmp_path)
+        cpu_only = [e for e in _device_events() if e.get("pid") != 3]
+        _write_device_gz(d, cpu_only)
+        s = T.summarize_device_trace(T.load_trace_events(d))
+        assert s["module_count"] == 0 and s["module_us"] == 0.0
+        assert s["ops"] == {}
+
+    def test_find_trace_files(self, tmp_path):
+        d = str(tmp_path)
+        assert T.find_trace_files(d) == {"host": None, "device": None}
+        dev = _write_device_gz(os.path.join(d, "plugins"),
+                               _device_events())
+        host = _write_host_json(d, _host_events())
+        found = T.find_trace_files(d)
+        assert found == {"host": host, "device": dev}
+
+
+class TestMerge:
+    def test_merge_separates_pids_and_normalizes(self):
+        merged = T.merge_trace_events(_host_events(base=5000.0),
+                                      _device_events(base=77000.0))
+        host_x = [e for e in merged
+                  if e.get("ph") == "X" and e["pid"] == T.HOST_PID]
+        assert {e["name"] for e in host_x} == {"frame.prepare",
+                                               "frame.d2h"}
+        # each stream re-zeroed on its own start despite wild bases
+        assert min(e["ts"] for e in host_x) == 0.0
+        dev_x = [e for e in merged
+                 if e.get("ph") == "X" and e["pid"] != T.HOST_PID]
+        assert min(e["ts"] for e in dev_x) == 0.0
+        # device pids renumbered 1.. — never colliding with the host lane
+        assert T.HOST_PID not in {e["pid"] for e in dev_x}
+
+    def test_summarize_merged_overlap_math(self):
+        # on the common normalized clock: host busy [0,100]+[150,200],
+        # device modules [0,50]+[120,180] -> overlap [0,50]+[150,180]
+        s = T.summarize_merged(_host_events(), _device_events())
+        assert s["host_busy_us"] == 150.0
+        assert s["host_stage_us"] == {"frame.d2h": 50.0,
+                                      "frame.prepare": 100.0}
+        assert s["host_stage_calls"] == {"frame.d2h": 1,
+                                         "frame.prepare": 1}
+        assert s["device_busy_us"] == 110.0
+        assert s["overlap_us"] == 80.0
+        assert s["host_overlap_frac"] == pytest.approx(80.0 / 150.0,
+                                                       abs=1e-4)
+        assert s["device_busy_frac"] == pytest.approx(110.0 / 180.0,
+                                                      abs=1e-4)
+        assert s["wall_us"] == 200.0
+        assert s["device"]["module_count"] == 2
+        assert s["top_ops"][0]["name"] == "fusion.1"
+
+    def test_summarize_merged_host_only_and_device_only(self):
+        s = T.summarize_merged(_host_events(), [])
+        assert s["device_busy_us"] == 0.0
+        assert s["device_busy_frac"] is None
+        assert s["host_busy_us"] == 150.0
+        assert s["overlap_us"] == 0.0
+        s2 = T.summarize_merged([], _device_events())
+        assert s2["host_busy_us"] == 0.0
+        assert s2["host_overlap_frac"] is None
+        assert s2["device_busy_us"] == 110.0
+
+    def test_tracer_export_feeds_merge(self, tmp_path):
+        """The real producer path: Tracer.export_chrome_trace output is
+        loadable and mergeable with a device fixture."""
+        tr = Tracer(ring=16)
+        with tr.span("frame.prepare"):
+            pass
+        path = os.path.join(str(tmp_path), "run.host.trace.json")
+        tr.export_chrome_trace(path)
+        host_events = T.load_host_trace_events(path)
+        s = T.summarize_merged(host_events, _device_events())
+        assert "frame.prepare" in s["host_stage_us"]
+        assert s["device"]["module_count"] == 2
+
+
+class TestCLI:
+    def test_trace_cli_end_to_end_on_fixtures(self, tmp_path):
+        """ISSUE 3 acceptance: ``python -m tpudl.obs trace <dir>`` on a
+        dir holding a host-span export AND a device trace prints a
+        merged summary (device busy, host stage totals, overlap) and
+        writes the merged Chrome trace."""
+        d = str(tmp_path)
+        _write_device_gz(d, _device_events())
+        _write_host_json(d, _host_events())
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpudl.obs", "trace", d],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = proc.stdout
+        assert "device busy:" in out and "110" in out
+        assert "host stages:" in out and "frame.prepare" in out
+        assert "host/device overlap:" in out
+        assert "top device ops:" in out and "fusion.1" in out
+        merged_path = os.path.join(d, "merged.trace.json")
+        assert os.path.exists(merged_path)
+        with open(merged_path) as f:
+            doc = json.load(f)
+        names = {e.get("name") for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert {"frame.prepare", "jit_step"} <= names
+
+    def test_trace_cli_newer_gzipped_host_export_not_mistaken_for_device(
+            self, tmp_path, capsys):
+        """A gzipped HOST export written after the device trace must not
+        shadow it: the CLI loads the exact device file find_trace_files
+        selected, not the newest *.trace.json.gz."""
+        import gzip as _gzip
+        import time as _time
+
+        from tpudl.obs.__main__ import main
+
+        d = str(tmp_path)
+        dev = _write_device_gz(d, _device_events())
+        _time.sleep(0.05)
+        host_gz = os.path.join(d, "run.host.trace.json.gz")
+        with _gzip.open(host_gz, "wt") as f:
+            json.dump({"traceEvents": _host_events()}, f)
+        assert os.path.getmtime(host_gz) >= os.path.getmtime(dev)
+        assert main(["trace", d]) == 0
+        out = capsys.readouterr().out
+        assert "2 module executions" in out  # device stream is the real one
+        assert "frame.prepare" in out       # host stream still merged
+
+    def test_trace_cli_empty_dir_fails_cleanly(self, tmp_path):
+        from tpudl.obs.__main__ import main
+
+        assert main(["trace", str(tmp_path)]) == 2
+
+    def test_trace_cli_host_only_inprocess(self, tmp_path, capsys):
+        from tpudl.obs.__main__ import main
+
+        d = str(tmp_path)
+        _write_host_json(d, _host_events())
+        assert main(["trace", d]) == 0
+        out = capsys.readouterr().out
+        assert "host stages:" in out and "frame.d2h" in out
+
+    def test_metrics_cli_validates_file(self, tmp_path, capsys):
+        from tpudl.obs.__main__ import main
+
+        path = str(tmp_path / "m.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(
+                {"ts": 1.0, "event": "final", "pid": 1,
+                 "metrics": {"a.b": {"type": "counter",
+                                     "value": 3}}}) + "\n")
+        assert main(["metrics", path]) == 0
+        out = capsys.readouterr().out
+        assert "a.b" in out and "OK" in out
+        with open(path, "a") as f:
+            f.write("garbage\n")
+        assert main(["metrics", path]) == 1

@@ -1,0 +1,136 @@
+"""Host-span tracer tests (ISSUE 3 tentpole pillar 1)."""
+
+import json
+import threading
+
+import pytest
+
+from tpudl.obs.tracer import Tracer
+
+
+def test_span_records_name_duration_thread_attrs():
+    tr = Tracer(ring=16)
+    with tr.span("decode", batch=3, run="r1"):
+        pass
+    (s,) = tr.spans()
+    assert s.name == "decode"
+    assert s.dur_us >= 0.0
+    assert s.tid == threading.current_thread().ident
+    assert s.attrs == {"batch": 3, "run": "r1"}
+
+
+def test_ring_is_bounded_and_counts_drops():
+    tr = Tracer(ring=8)
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+    spans = tr.spans()
+    assert len(spans) == 8
+    # newest survive, oldest dropped
+    assert [s.name for s in spans] == [f"s{i}" for i in range(12, 20)]
+    assert tr.dropped == 12
+
+
+def test_error_span_still_recorded_with_error_attr():
+    tr = Tracer(ring=8)
+    with pytest.raises(ValueError):
+        with tr.span("boom", k=1):
+            raise ValueError("x")
+    (s,) = tr.spans()
+    assert s.name == "boom"
+    assert s.attrs["error"] == "ValueError"
+    assert s.attrs["k"] == 1
+
+
+def test_threads_get_distinct_tids_and_names():
+    tr = Tracer(ring=32)
+
+    def work():
+        with tr.span("worker"):
+            pass
+
+    t = threading.Thread(target=work, name="obs-test-worker")
+    t.start()
+    t.join()
+    with tr.span("main"):
+        pass
+    by_name = {s.name: s for s in tr.spans()}
+    assert by_name["worker"].tid != by_name["main"].tid
+    assert by_name["worker"].thread_name == "obs-test-worker"
+
+
+def test_export_chrome_trace_format(tmp_path):
+    tr = Tracer(ring=8)
+    with tr.span("prepare", run="r0"):
+        pass
+    with tr.span("dispatch"):
+        pass
+    path = str(tmp_path / "x.host.trace.json")
+    tr.export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    procs = [e for e in events
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert procs and procs[0]["args"]["name"] == "tpudl host"
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert [e["name"] for e in xs] == ["prepare", "dispatch"]
+    for e in xs:
+        assert e["ts"] > 0 and e["dur"] >= 0 and "pid" in e and "tid" in e
+    assert xs[0]["args"] == {"run": "r0"}
+    # spans are on one epoch-µs clock: ordering survives the export
+    assert xs[0]["ts"] <= xs[1]["ts"]
+
+
+def test_export_window_filters_spans(tmp_path):
+    """window=(start,end) / window="profile" export only overlapping
+    spans — a long-lived ring must not pollute a capture's merge."""
+    tr = Tracer(ring=16)
+    with tr.span("before"):
+        pass
+    import time as _time
+
+    w0 = _time.time() * 1e6
+    with tr.span("inside"):
+        pass
+    w1 = _time.time() * 1e6
+    _time.sleep(0.002)
+    with tr.span("after"):
+        pass
+    names = [e["name"] for e in tr.to_events(window=(w0, w1))
+             if e.get("ph") == "X"]
+    assert names == ["inside"]
+    # "profile" resolves the window obs.profile recorded
+    tr.last_profile_window = (w0, w1)
+    path = str(tmp_path / "w.host.trace.json")
+    tr.export_chrome_trace(path, window="profile")
+    with open(path) as f:
+        doc = json.load(f)
+    assert [e["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "X"] == ["inside"]
+    # no window recorded -> full export rather than empty
+    tr.last_profile_window = None
+    tr.export_chrome_trace(path, window="profile")
+    with open(path) as f:
+        full = json.load(f)
+    assert len([e for e in full["traceEvents"]
+                if e.get("ph") == "X"]) == 3
+
+
+def test_clear_resets_ring():
+    tr = Tracer(ring=4)
+    with tr.span("a"):
+        pass
+    tr.clear()
+    assert tr.spans() == [] and tr.dropped == 0
+
+
+def test_module_level_span_lands_on_default_tracer():
+    from tpudl import obs
+
+    before = len(obs.get_tracer().spans())
+    with obs.span("module.level"):
+        pass
+    spans = obs.get_tracer().spans()
+    assert len(spans) >= before  # ring may wrap, but the newest is ours
+    assert spans[-1].name == "module.level"

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Schema checks for tpudl's observability emissions.
+
+Two contracts live here (wired into tier-1 via
+tests/test_bench_contract.py and tests/test_obs_metrics.py, so a
+malformed emission fails CI, not a downstream dashboard):
+
+1. the metrics JSONL a ``TPUDL_METRICS_FILE`` sink appends
+   (:mod:`tpudl.obs.metrics` — one JSON object per line:
+   ``{ts, event, pid, metrics: {name: typed-dict}}``);
+2. the bench's judged LAST-line summary (``bench.py _compact_summary``
+   — flat JSON, required keys, < 1500 chars, nothing nested deeper
+   than one list-of-scalars).
+
+Pure stdlib, importable (``from validate_metrics import ...``) and
+runnable (``python tools/validate_metrics.py <file.jsonl>``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+_NUM = (int, float)
+_METRIC_KEYS = {
+    "counter": {"value": _NUM},
+    "gauge": {"value": (*_NUM, type(None)), "count": int,
+              "max": (*_NUM, type(None)), "mean": (*_NUM, type(None))},
+    "histogram": {"count": int, "sum": _NUM,
+                  "min": (*_NUM, type(None)), "max": (*_NUM, type(None)),
+                  "mean": (*_NUM, type(None)), "p50": (*_NUM, type(None)),
+                  "p95": (*_NUM, type(None)), "p99": (*_NUM, type(None))},
+}
+SUMMARY_REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline")
+SUMMARY_MAX_CHARS = 1500
+
+
+def validate_metric_entry(name: str, entry) -> list[str]:
+    """Errors in one ``metrics[name]`` typed dict (empty list = valid)."""
+    errs = []
+    if not isinstance(entry, dict):
+        return [f"metric {name!r}: not an object"]
+    kind = entry.get("type")
+    if kind not in _METRIC_KEYS:
+        return [f"metric {name!r}: unknown type {kind!r}"]
+    if isinstance(entry.get("value"), bool) or any(
+            isinstance(entry.get(k), bool) for k in _METRIC_KEYS[kind]):
+        errs.append(f"metric {name!r}: boolean where number expected")
+    for key, types in _METRIC_KEYS[kind].items():
+        if key not in entry:
+            errs.append(f"metric {name!r} ({kind}): missing key {key!r}")
+        elif not isinstance(entry[key], types):
+            errs.append(
+                f"metric {name!r} ({kind}): {key}="
+                f"{entry[key]!r} is not {types}")
+    return errs
+
+
+def validate_metrics_line(line: str, lineno: int = 0) -> list[str]:
+    """Errors in one JSONL line (empty list = valid)."""
+    where = f"line {lineno}" if lineno else "line"
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError as e:
+        return [f"{where}: not JSON ({e})"]
+    if not isinstance(obj, dict):
+        return [f"{where}: not a JSON object"]
+    errs = []
+    if not isinstance(obj.get("ts"), _NUM):
+        errs.append(f"{where}: ts missing or non-numeric")
+    if obj.get("event") not in ("snapshot", "final"):
+        errs.append(f"{where}: event must be snapshot|final, "
+                    f"got {obj.get('event')!r}")
+    if not isinstance(obj.get("pid"), int):
+        errs.append(f"{where}: pid missing or non-int")
+    metrics = obj.get("metrics")
+    if not isinstance(metrics, dict):
+        errs.append(f"{where}: metrics missing or not an object")
+    else:
+        for name, entry in metrics.items():
+            errs.extend(f"{where}: {e}"
+                        for e in validate_metric_entry(name, entry))
+    return errs
+
+
+def validate_metrics_file(path: str):
+    """(errors, n_lines, last_parsed_line) for a metrics JSONL file."""
+    errors, n, last = [], 0, None
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            if not line.strip():
+                continue
+            n += 1
+            errs = validate_metrics_line(line, i)
+            errors.extend(errs)
+            if not errs:
+                last = json.loads(line)
+    if n == 0:
+        errors.append(f"{path}: no JSONL lines")
+    return errors, n, last
+
+
+def validate_bench_summary_line(line: str) -> list[str]:
+    """Errors in the bench's judged last-line summary (empty = valid)."""
+    errs = []
+    if len(line) >= SUMMARY_MAX_CHARS:
+        errs.append(f"summary line is {len(line)} chars "
+                    f"(contract: < {SUMMARY_MAX_CHARS})")
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError as e:
+        return errs + [f"summary line is not JSON ({e})"]
+    if not isinstance(obj, dict):
+        return errs + ["summary line is not a JSON object"]
+    for key in SUMMARY_REQUIRED_KEYS:
+        if key not in obj:
+            errs.append(f"summary missing required key {key!r}")
+    if "value" in obj and not isinstance(obj["value"], (*_NUM, type(None))):
+        errs.append(f"summary value={obj['value']!r} is not number|null")
+    for k, v in obj.items():
+        if isinstance(v, list):
+            if not all(isinstance(x, _NUM) for x in v):
+                errs.append(f"summary key {k!r}: list holds non-scalars")
+        elif isinstance(v, dict):
+            errs.append(f"summary key {k!r}: nested object "
+                        "(contract: one level, scalars only)")
+    return errs
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: validate_metrics.py <metrics.jsonl>", file=sys.stderr)
+        return 2
+    errors, n, _last = validate_metrics_file(argv[1])
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    print(f"{argv[1]}: {n} lines, "
+          f"{'OK' if not errors else str(len(errors)) + ' errors'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

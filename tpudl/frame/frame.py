@@ -653,7 +653,9 @@ class Frame:
         if mode == "acc":
             with report.stage("d2h"):
                 _fetch_accumulated(acc, segs, outputs)
-        report.wall_seconds = time.perf_counter() - t_wall
+        # close out the run: wall time + publish totals into the
+        # process-wide metrics registry (obs.snapshot() / JSONL sink)
+        report.finish(time.perf_counter() - t_wall)
         out = self
         for name, chunks in zip(output_cols, outputs):
             col = np.concatenate(chunks, axis=0) if chunks else np.empty((0,))

@@ -25,6 +25,7 @@ from typing import Callable, Iterable
 import numpy as np
 
 from tpudl.frame.frame import LazyColumn
+from tpudl.obs import metrics as _obs_metrics
 
 try:  # PIL is the decode substrate, mirroring the reference's Python path
     from PIL import Image
@@ -460,7 +461,14 @@ class LazyFileColumn(LazyColumn):
         return raw
 
     def _read_batch(self, indices: np.ndarray) -> list[bytes]:
-        return _parallel_map(self._read_raw, indices, self.io_workers)
+        raws = _parallel_map(self._read_raw, indices, self.io_workers)
+        # counted per BATCH, not per file: the parallel readers must
+        # not contend on the process-wide registry lock per read
+        if raws:
+            _obs_metrics.counter("imageio.files_read").inc(len(raws))
+            _obs_metrics.counter("imageio.bytes_read").inc(
+                sum(len(r) for r in raws))
+        return raws
 
     # memo only SMALL accesses (head()/limit()/collect-after-head reuse);
     # executor-sized map batches skip it, so no batch of decoded images
@@ -496,6 +504,7 @@ class LazyFileColumn(LazyColumn):
         with self._memo_lock:
             memo = self._memo
         if memo is not None and memo[0] == key:
+            _obs_metrics.counter("imageio.memo_hits").inc()
             return _copy_rows(memo[1])
         raws = self._read_batch(indices)
         out = self._decode_batch(indices, raws)
@@ -623,8 +632,10 @@ def _decode_row(decode_f, origin, raw):
     try:
         out = decode_f(raw)
     except Exception:
+        _obs_metrics.counter("imageio.decode_errors").inc()
         return None
     if out is None:
+        _obs_metrics.counter("imageio.decode_errors").inc()
         return None
     if isinstance(out, dict):
         out = dict(out)

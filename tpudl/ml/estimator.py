@@ -34,6 +34,8 @@ import jax.numpy as jnp
 
 from tpudl import mesh as M
 from tpudl.ml.image_params import CanLoadImage
+from tpudl.obs import metrics as _obs_metrics
+from tpudl.obs import tracer as _obs_tracer
 from tpudl.ml.keras_image import KerasImageFileTransformer
 from tpudl.ml.losses import get_loss, get_optimizer_dynamic
 from tpudl.ml.params import (HasInputCol, HasKerasLoss, HasKerasModel,
@@ -212,26 +214,36 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         width = len(devs) if submesh is not None else 1
         target = math.ceil(batch_size / width) * width
         losses = []
-        for _epoch in range(epochs):
-            order = rng.permutation(n) if shuffle else np.arange(n)
-            batch_losses = []  # device-resident; ONE fetch per epoch
-            for start in range(0, n, target):
-                idx = order[start:start + target]
-                if len(idx) < target:
-                    reps = math.ceil((target - len(idx)) / n)
-                    fill = np.concatenate([order] * reps)[: target - len(idx)]
-                    idx = np.concatenate([idx, fill])
-                xb, yb = X[idx], y[idx]
-                if submesh is not None:
-                    xb, yb = M.shard_batch((xb, yb), submesh)
-                elif devs is not None:
-                    xb, yb = jax.device_put((xb, yb), devs[0])
-                params, opt_state, loss = entry.step(
-                    params, opt_state, xb, yb)
-                batch_losses.append(loss)
-            # the epoch's loss is the MEAN over its batches (one batch's
-            # noise is a misleading trial score for CrossValidator)
-            losses.append(float(jnp.mean(jnp.stack(batch_losses))))
+        n_steps = 0
+        with _obs_tracer.span("estimator.train_trial", epochs=epochs,
+                              batch_size=target, slice_width=width):
+            for _epoch in range(epochs):
+                order = rng.permutation(n) if shuffle else np.arange(n)
+                batch_losses = []  # device-resident; ONE fetch per epoch
+                for start in range(0, n, target):
+                    idx = order[start:start + target]
+                    if len(idx) < target:
+                        reps = math.ceil((target - len(idx)) / n)
+                        fill = np.concatenate(
+                            [order] * reps)[: target - len(idx)]
+                        idx = np.concatenate([idx, fill])
+                    xb, yb = X[idx], y[idx]
+                    if submesh is not None:
+                        xb, yb = M.shard_batch((xb, yb), submesh)
+                    elif devs is not None:
+                        xb, yb = jax.device_put((xb, yb), devs[0])
+                    params, opt_state, loss = entry.step(
+                        params, opt_state, xb, yb)
+                    batch_losses.append(loss)
+                    n_steps += 1
+                # the epoch's loss is the MEAN over its batches (one
+                # batch's noise is a misleading trial score for
+                # CrossValidator)
+                losses.append(float(jnp.mean(jnp.stack(batch_losses))))
+        _obs_metrics.counter("estimator.trials").inc()
+        _obs_metrics.counter("estimator.train_steps").inc(n_steps)
+        if losses:
+            _obs_metrics.gauge("estimator.trial_final_loss").set(losses[-1])
         return params, losses
 
     # -- model materialization --------------------------------------------

@@ -22,10 +22,14 @@ once and compile once per distinct device slice.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, Iterator, Sequence
 
 import jax
+
+from tpudl.obs import metrics as _obs_metrics
+from tpudl.obs import tracer as _obs_tracer
 
 __all__ = ["TrialScheduler", "device_slices"]
 
@@ -79,9 +83,23 @@ class TrialScheduler:
         def run_one(i, item):
             with free_lock:
                 s = free.pop()
+            # per-trial observability: span on the host timeline +
+            # started/completed/failed counters and a latency histogram
+            # in the registry (SURVEY.md §5.5 — HPO was a black box)
+            _obs_metrics.counter("hpo.trials_started").inc()
+            t0 = time.perf_counter()
             try:
-                return i, trial_fn(i, item, slices[s])
+                with _obs_tracer.span("hpo.trial", index=i,
+                                      slice_width=len(slices[s])):
+                    out = i, trial_fn(i, item, slices[s])
+                _obs_metrics.counter("hpo.trials_completed").inc()
+                return out
+            except BaseException:
+                _obs_metrics.counter("hpo.trials_failed").inc()
+                raise
             finally:
+                _obs_metrics.histogram("hpo.trial_seconds").observe(
+                    time.perf_counter() - t0)
                 with free_lock:
                     free.append(s)
 

@@ -17,15 +17,27 @@ deliberately identical where sparkdl's code depends on them:
 from __future__ import annotations
 
 from tpudl.ml.params import Params
+from tpudl.obs import metrics as _obs_metrics
+from tpudl.obs import tracer as _obs_tracer
 
 __all__ = ["Transformer", "Estimator", "Model", "Pipeline", "PipelineModel"]
 
 
 class Transformer(Params):
     def transform(self, frame, params: dict | None = None):
-        if params:
-            return self.copy(params)._transform(frame)
-        return self._transform(frame)
+        # every transformer reports here (rows in/out, wall-time
+        # histogram, host span) — subclasses instrument for free
+        cls = type(self).__name__
+        with _obs_metrics.timed(f"ml.{cls}.transform_seconds"), \
+                _obs_tracer.span(f"ml.{cls}.transform", rows=len(frame)):
+            if params:
+                out = self.copy(params)._transform(frame)
+            else:
+                out = self._transform(frame)
+        _obs_metrics.counter(f"ml.{cls}.transforms").inc()
+        _obs_metrics.counter(f"ml.{cls}.rows_in").inc(len(frame))
+        _obs_metrics.counter(f"ml.{cls}.rows_out").inc(len(out))
+        return out
 
     def _transform(self, frame):  # pragma: no cover - abstract
         raise NotImplementedError
@@ -84,14 +96,20 @@ class Model(Transformer):
 
 class Estimator(Params):
     def fit(self, frame, params=None):
-        if isinstance(params, (list, tuple)):
-            models = [None] * len(params)
-            for i, m in self.fitMultiple(frame, list(params)):
-                models[i] = m
-            return models
-        if params:
-            return self.copy(params)._fit(frame)
-        return self._fit(frame)
+        cls = type(self).__name__
+        with _obs_metrics.timed(f"ml.{cls}.fit_seconds"), \
+                _obs_tracer.span(f"ml.{cls}.fit", rows=len(frame)):
+            if isinstance(params, (list, tuple)):
+                models = [None] * len(params)
+                for i, m in self.fitMultiple(frame, list(params)):
+                    models[i] = m
+                out = models
+            elif params:
+                out = self.copy(params)._fit(frame)
+            else:
+                out = self._fit(frame)
+        _obs_metrics.counter(f"ml.{cls}.fits").inc()
+        return out
 
     def fitMultiple(self, frame, paramMaps):
         """Iterator of (index, model) as each trial finishes. Default:

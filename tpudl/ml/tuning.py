@@ -28,6 +28,8 @@ import numpy as np
 
 from tpudl.ml.params import Param, Params, keyword_only
 from tpudl.ml.pipeline import Estimator, Model
+from tpudl.obs import metrics as _obs_metrics
+from tpudl.obs import tracer as _obs_tracer
 
 __all__ = ["ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
            "Evaluator", "FunctionEvaluator"]
@@ -159,11 +161,18 @@ class CrossValidator(Estimator):
             val = frame.filter_rows(val_mask)
             # completion-order consumption: evaluate each model the
             # moment its trial finishes (SURVEY.md §7.3 contract)
-            for i, model in est.fitMultiple(train, maps):
-                metrics[i, f] = ev.evaluate(model.transform(val))
+            with _obs_tracer.span("tuning.cv_fold", fold=f,
+                                  n_maps=len(maps)):
+                for i, model in est.fitMultiple(train, maps):
+                    metrics[i, f] = ev.evaluate(model.transform(val))
+                    _obs_metrics.counter("tuning.cv_evaluations").inc()
+                    _obs_metrics.gauge("tuning.cv_last_metric").set(
+                        metrics[i, f])
+        _obs_metrics.counter("tuning.cv_folds").inc(len(folds))
         avg = metrics.mean(axis=1)
         best = int(np.argmax(avg) if ev.isLargerBetter()
                    else np.argmin(avg))
+        _obs_metrics.gauge("tuning.cv_best_metric").set(avg[best])
         best_model = est.fit(frame, maps[best])  # refit on ALL rows
         return CrossValidatorModel(best_model, avg.tolist(), best)
 

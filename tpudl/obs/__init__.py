@@ -1,0 +1,49 @@
+"""Observability: host-span tracing, process-wide metrics, device traces.
+
+SURVEY.md §5.1/§5.5: the reference had NO first-party tracing or metrics
+(observability was inherited from the Spark UI). This package is the
+run-wide subsystem that replaces it (OBSERVABILITY.md is the operator
+guide), three pillars:
+
+- :mod:`tpudl.obs.tracer` — host-span tracer: ``obs.span("stage")``
+  records thread-aware wall-clock spans into a bounded ring, exportable
+  as Chrome trace-event JSON;
+- :mod:`tpudl.obs.metrics` — process-wide metrics registry: thread-safe
+  counters/gauges/bounded-histograms with ``snapshot()`` and an opt-in
+  JSONL sink (``TPUDL_METRICS_FILE``);
+- :mod:`tpudl.obs.trace` — jax.profiler capture + trace-viewer parsing,
+  and the host/device MERGE: ``python -m tpudl.obs trace <dir>`` renders
+  host spans and XLA device lanes on one timeline with a combined
+  summary (device busy %, host stage totals, overlap).
+
+Per-run executor reports (:class:`PipelineReport`) live in
+:mod:`tpudl.obs.pipeline`, kept in a bounded ring keyed by run id;
+``last_pipeline_report()`` stays the newest entry.
+"""
+
+from __future__ import annotations
+
+from tpudl.obs.metrics import (Meter, counter, flush_metrics, gauge,
+                               get_registry, histogram, snapshot, timed)
+from tpudl.obs.pipeline import (PipelineReport, get_pipeline_report,
+                                last_pipeline_report, pipeline_reports,
+                                set_last_pipeline)
+from tpudl.obs.trace import (load_host_trace_events, load_trace_events,
+                             merge_trace_events, named_scope, profile,
+                             summarize_device_trace, summarize_merged)
+from tpudl.obs.tracer import export_chrome_trace, get_tracer, span
+
+__all__ = [
+    # tracer
+    "span", "get_tracer", "export_chrome_trace",
+    # metrics
+    "counter", "gauge", "histogram", "snapshot", "flush_metrics",
+    "get_registry", "timed", "Meter",
+    # device traces + merge
+    "profile", "named_scope", "load_trace_events",
+    "summarize_device_trace", "load_host_trace_events",
+    "merge_trace_events", "summarize_merged",
+    # per-run pipeline reports
+    "PipelineReport", "last_pipeline_report", "set_last_pipeline",
+    "pipeline_reports", "get_pipeline_report",
+]

@@ -1,0 +1,129 @@
+"""``python -m tpudl.obs`` — the observability CLI.
+
+``trace <dir>`` merges the newest host-span export
+(``*.host.trace.json[.gz]``, written by
+``obs.get_tracer().export_chrome_trace``) with the newest jax.profiler
+device trace (``*.trace.json.gz``) under ``<dir>``, writes the combined
+Chrome trace to ``<dir>/merged.trace.json`` (open it in Perfetto /
+chrome://tracing) and prints the merged summary: device busy time, host
+stage totals, overlap, top ops. Either stream alone still summarizes —
+a CPU-only run gets host totals, a host-blind capture gets device lanes.
+
+``metrics <file.jsonl>`` schema-checks and tail-summarizes a
+``TPUDL_METRICS_FILE`` emission (delegates the check to
+``tools/validate_metrics.py``'s rules).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpudl.obs import trace as T
+
+
+def _fmt_us(us: float) -> str:
+    return f"{us / 1e3:.2f} ms" if us >= 1e3 else f"{us:.0f} us"
+
+
+def cmd_trace(trace_dir: str, out_path: str | None = None) -> int:
+    found = T.find_trace_files(trace_dir)
+    host_events = (T.load_host_trace_events(found["host"])
+                   if found["host"] else [])
+    # load the exact file find_trace_files selected (a re-glob could
+    # pick a newer gzipped HOST export as the device stream);
+    # load_host_trace_events is format-wise just "events from one
+    # [gzipped] trace JSON", which is what's needed here
+    device_events = (T.load_host_trace_events(found["device"])
+                     if found["device"] else [])
+    if not host_events and not device_events:
+        print(f"no host or device traces under {trace_dir}",
+              file=sys.stderr)
+        return 2
+    print(f"host trace:   {found['host'] or '(none)'}")
+    print(f"device trace: {found['device'] or '(none)'}")
+    merged = T.merge_trace_events(host_events, device_events)
+    out_path = out_path or os.path.join(trace_dir, "merged.trace.json")
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    print(f"merged trace: {out_path} (open in Perfetto / chrome://tracing)")
+    s = T.summarize_merged(host_events, device_events)
+    print("\n== merged timeline summary ==")
+    print(f"wall window:        {_fmt_us(s['wall_us'])}")
+    busy = s["device_busy_frac"]
+    print(f"device busy:        {_fmt_us(s['device_busy_us'])}"
+          + (f" ({busy:.1%} of device window)" if busy is not None else "")
+          + f" across {s['device']['module_count']} module executions")
+    print(f"host busy:          {_fmt_us(s['host_busy_us'])}")
+    ov = s["host_overlap_frac"]
+    print(f"host/device overlap: {_fmt_us(s['overlap_us'])}"
+          + (f" ({ov:.1%} of host work hidden under device compute)"
+             if ov is not None else ""))
+    if s["host_stage_us"]:
+        print("host stages:")
+        for name, us in sorted(s["host_stage_us"].items(),
+                               key=lambda kv: -kv[1]):
+            print(f"  {name:<28} {_fmt_us(us):>12}"
+                  f"  x{s['host_stage_calls'][name]}")
+    if s["top_ops"]:
+        print("top device ops:")
+        for op in s["top_ops"]:
+            print(f"  {op['name']:<28} {_fmt_us(op['us']):>12}"
+                  f"  x{op['count']}  {op['category']}")
+    return 0
+
+
+def cmd_metrics(path: str) -> int:
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tools"))
+    try:
+        from validate_metrics import validate_metrics_file
+    except ImportError:
+        # installed wheels ship only tpudl.*; the validator lives in the
+        # repo's tools/ dir
+        print("tools/validate_metrics.py not found (run from a source "
+              "checkout, or use tools/validate_metrics.py directly)",
+              file=sys.stderr)
+        return 2
+
+    errors, n_lines, last = validate_metrics_file(path)
+    for err in errors:
+        print(f"INVALID: {err}", file=sys.stderr)
+    print(f"{path}: {n_lines} lines, "
+          f"{'OK' if not errors else f'{len(errors)} errors'}")
+    if last:
+        print(f"last snapshot ({last.get('event')}, pid {last.get('pid')}):")
+        for name, m in sorted(last.get("metrics", {}).items()):
+            if m["type"] == "counter":
+                print(f"  {name:<40} {m['value']}")
+            elif m["type"] == "gauge":
+                print(f"  {name:<40} {m['value']} "
+                      f"(mean {m.get('mean')}, max {m.get('max')})")
+            else:
+                print(f"  {name:<40} n={m['count']} mean={m.get('mean')} "
+                      f"p95={m.get('p95')}")
+    return 0 if not errors else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpudl.obs",
+        description="merge + summarize tpudl traces and metrics")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pt = sub.add_parser("trace", help="merge host + device traces in a dir")
+    pt.add_argument("trace_dir")
+    pt.add_argument("--out", default=None,
+                    help="merged trace path (default <dir>/merged.trace.json)")
+    pm = sub.add_parser("metrics", help="validate + summarize a metrics JSONL")
+    pm.add_argument("path")
+    args = p.parse_args(argv)
+    if args.cmd == "trace":
+        return cmd_trace(args.trace_dir, args.out)
+    return cmd_metrics(args.path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

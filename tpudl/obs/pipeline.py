@@ -1,0 +1,212 @@
+"""Per-run pipeline reports: stage times, gauges, and the report ring.
+
+``PipelineReport`` is ONE ``Frame.map_batches`` run's stage accounting
+(PIPELINE.md has the reading guide). This module also owns the ring of
+recent reports — keyed by run id, bounded at ``TPUDL_PIPELINE_RING``
+(default 16) — which replaces the old single racy ``_LAST_PIPELINE``
+global: two concurrent runs (HPO trials in threads) each keep their own
+retrievable, internally-consistent report, and
+``last_pipeline_report()`` stays the newest entry for every existing
+caller. On ``finish()`` a report ALSO publishes its totals into the
+process-wide metrics registry (:mod:`tpudl.obs.metrics`), so run-level
+stage seconds accumulate across a whole process alongside every other
+layer's metrics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from collections import deque
+
+from tpudl.obs import metrics as _metrics
+from tpudl.obs import tracer as _tracer
+
+__all__ = ["PipelineReport", "last_pipeline_report", "set_last_pipeline",
+           "pipeline_reports", "get_pipeline_report"]
+
+# per-gauge retained samples; running aggregates keep mean/max exact
+# over ALL samples (a long streaming run must not grow without bound)
+GAUGE_SAMPLE_CAP = 4096
+
+_run_counter = itertools.count()
+
+
+def _next_run_id() -> str:
+    return f"{os.getpid()}-{next(_run_counter)}"
+
+
+class PipelineReport:
+    """Per-stage wall time + gauges for ONE ``Frame.map_batches`` run.
+
+    The stage-time model (PIPELINE.md has the reading guide):
+
+    - ``prepare``: worker-thread seconds in decode/pack (summed across
+      the prepare pool — N workers can make this exceed wall time);
+    - ``h2d``: the explicit shard + host→device transfer inside prepare
+      (mesh path only; on the mesh=None tunnel path the transfer rides
+      the dispatch, see map_batches);
+    - ``dispatch``: consumer-thread seconds in ``fn(...)`` — enqueue
+      only for async device fns, enqueue+compute for host fns;
+    - ``d2h``: device→host fetch time (windowed drain + the acc-mode
+      final fetch);
+    - ``infeed_wait``: consumer seconds blocked on the infeed queue —
+      the UNHIDDEN remainder of prepare, and the numerator of
+      ``overlap_efficiency``.
+
+    Gauges (``gauge``) keep a bounded ring of samples (last
+    ``GAUGE_SAMPLE_CAP``) plus running count/sum/max, so the reported
+    mean/max stay exact over ALL samples at O(cap) memory
+    (``queue_depth`` is sampled at each consumer take: depth K means the
+    pool is keeping the device fed). Thread-safe: prepare workers and
+    the consumer thread write concurrently.
+
+    Each stage() block also lands on the host-span tracer (named
+    ``frame.<stage>``, tagged with this run's id), so an exported host
+    trace shows the executor's stages on the merged timeline.
+    """
+
+    def __init__(self):
+        self.run_id = _next_run_id()
+        self.stages: dict[str, float] = {}
+        self.calls: dict[str, int] = {}
+        self.gauges: dict[str, _metrics.Histogram] = {}
+        self.wall_seconds = 0.0
+        self.config: dict = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        with _tracer.span(f"frame.{name}", run=self.run_id):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float):
+        with self._lock:
+            self.stages[name] = self.stages.get(name, 0.0) + seconds
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def count(self, name: str, k: int = 1):
+        with self._lock:
+            self.calls[name] = self.calls.get(name, 0) + k
+
+    def gauge(self, name: str, value):
+        with self._lock:
+            h = self.gauges.get(name)
+            if h is None:
+                # one authority for "bounded samples + exact running
+                # aggregates": the registry's Histogram (unregistered —
+                # these samples are per-run, not process-wide)
+                h = self.gauges[name] = _metrics.Histogram(
+                    cap=GAUGE_SAMPLE_CAP)
+        h.observe(value)
+
+    def overlap_efficiency(self) -> float | None:
+        """Fraction of host prepare work hidden under device compute:
+        1 - infeed_wait/prepare, clamped to [0, 1]. 1.0 = the consumer
+        never waited (prepare fully overlapped); 0.0 = fully serial.
+        None when nothing was prepared (empty frame / no prefetch)."""
+        prep = self.stages.get("prepare", 0.0)
+        if prep <= 0.0:
+            return None
+        wait = self.stages.get("infeed_wait", 0.0)
+        return max(0.0, min(1.0, 1.0 - wait / prep))
+
+    def finish(self, wall_seconds: float | None = None):
+        """Close out the run: record wall time and publish totals into
+        the process-wide metrics registry (map_batches runs/rows
+        counters, per-stage seconds, wall-time histogram). Called by the
+        executor; idempotent enough for tests (re-publishing would
+        double-count, so the executor calls it exactly once)."""
+        if wall_seconds is not None:
+            self.wall_seconds = wall_seconds
+        _metrics.counter("frame.map_batches.runs").inc()
+        rows = self.config.get("rows")
+        if rows:
+            _metrics.counter("frame.map_batches.rows").inc(rows)
+        _metrics.histogram("frame.map_batches.wall_seconds").observe(
+            self.wall_seconds)
+        with self._lock:
+            stages = dict(self.stages)
+            dispatches = self.calls.get("dispatch", 0)
+        if dispatches:
+            _metrics.counter("frame.map_batches.batches").inc(dispatches)
+        for name, secs in stages.items():
+            _metrics.counter(f"frame.stage.{name}.seconds").inc(secs)
+        eff = self.overlap_efficiency()
+        if eff is not None:
+            _metrics.gauge("frame.overlap_efficiency").set(eff)
+        _metrics.get_registry().maybe_flush()
+
+    def report(self) -> dict:
+        with self._lock:
+            out = {
+                "run_id": self.run_id,
+                "wall_seconds": round(self.wall_seconds, 4),
+                "stage_seconds": {k: round(v, 4)
+                                  for k, v in sorted(self.stages.items())},
+                "stage_calls": dict(sorted(self.calls.items())),
+            }
+            for name, h in sorted(self.gauges.items()):
+                d = h.to_dict()
+                out[f"{name}_mean"] = round(d["mean"], 2)
+                out[f"{name}_max"] = d["max"]
+            out.update(self.config)
+        eff = self.overlap_efficiency()
+        if eff is not None:
+            out["overlap_efficiency"] = round(eff, 3)
+        return out
+
+
+def _ring_size() -> int:
+    try:
+        return max(1, int(os.environ.get("TPUDL_PIPELINE_RING", "") or 16))
+    except ValueError:
+        return 16
+
+
+_REPORTS: deque = deque(maxlen=_ring_size())
+_REPORTS_LOCK = threading.Lock()
+
+
+def set_last_pipeline(report: PipelineReport | None):
+    """Filed by ``Frame.map_batches`` at the start of every run, so the
+    caller above any transformer stack (bench.py, a notebook) can read
+    the executor's stage breakdown without threading a handle through
+    the transformer APIs. Reports live in a bounded ring keyed by run
+    id — concurrent runs no longer clobber each other (each stays
+    retrievable via :func:`get_pipeline_report` /
+    :func:`pipeline_reports`)."""
+    if report is None:
+        return
+    with _REPORTS_LOCK:
+        _REPORTS.append(report)
+
+
+def last_pipeline_report() -> dict | None:
+    """Stage breakdown of the most recent map_batches run (or None)."""
+    with _REPORTS_LOCK:
+        newest = _REPORTS[-1] if _REPORTS else None
+    return newest.report() if newest is not None else None
+
+
+def pipeline_reports() -> dict[str, dict]:
+    """``{run_id: report_dict}`` for the ring's runs, oldest→newest."""
+    with _REPORTS_LOCK:
+        reports = list(_REPORTS)
+    return {r.run_id: r.report() for r in reports}
+
+
+def get_pipeline_report(run_id: str) -> dict | None:
+    """One ring entry by run id (None once evicted)."""
+    with _REPORTS_LOCK:
+        for r in _REPORTS:
+            if r.run_id == run_id:
+                return r.report()
+    return None

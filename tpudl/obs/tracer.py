@@ -1,0 +1,180 @@
+"""Host-span tracer: thread-aware wall-clock spans in a bounded ring.
+
+The host half of the merged timeline (OBSERVABILITY.md): any layer
+wraps work in ``obs.span("stage", **attrs)`` and the span lands in a
+process-wide ring buffer with thread id/name, run id and attributes.
+``export_chrome_trace`` writes the ring as Chrome trace-event JSON —
+the same format the jax.profiler's trace-viewer dump uses — so
+``python -m tpudl.obs trace <dir>`` can merge host prepare/dispatch/d2h
+spans with the XLA Module/Ops device lanes into one timeline
+(:mod:`tpudl.obs.trace`).
+
+Clock model: durations come from ``time.perf_counter()`` (monotonic,
+sub-µs); each span's start is stamped in epoch microseconds from a
+live ``time.time()`` read at span end, so exports stay aligned with
+wall-clock windows (``obs.profile`` records its capture window the
+same way) even across suspend/NTP steps. Device traces carry their own
+opaque time base; the merge normalizes each stream to its own start
+(see ``merge_trace_events``) — alignment is per-stream-relative, which
+is exact for the intended use (both streams captured over the same
+window by ``obs.profile`` + the tracer).
+
+Hot-loop discipline: recording a span is two perf_counter reads plus a
+lock-guarded deque append — the ring (``TPUDL_TRACE_RING`` spans,
+default 65536) never grows past its cap, so tracing can stay on in
+production.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ["Span", "Tracer", "get_tracer", "span", "export_chrome_trace"]
+
+_DEFAULT_RING = 65536
+
+
+class Span:
+    """One completed host span (times in epoch microseconds)."""
+
+    __slots__ = ("name", "ts_us", "dur_us", "tid", "thread_name", "attrs")
+
+    def __init__(self, name, ts_us, dur_us, tid, thread_name, attrs):
+        self.name = name
+        self.ts_us = ts_us
+        self.dur_us = dur_us
+        self.tid = tid
+        self.thread_name = thread_name
+        self.attrs = attrs
+
+    def to_event(self, pid: int) -> dict:
+        e = {"ph": "X", "name": self.name, "pid": pid, "tid": self.tid,
+             "ts": self.ts_us, "dur": self.dur_us}
+        if self.attrs:
+            e["args"] = dict(self.attrs)
+        return e
+
+
+class Tracer:
+    """Bounded, thread-safe span ring.
+
+    ``with tracer.span("decode", batch=3):`` records one span on exit;
+    raising inside the block still records it (the failing span is
+    usually the interesting one) with ``error`` set in its attrs.
+    """
+
+    def __init__(self, ring: int | None = None):
+        if ring is None:
+            try:
+                ring = int(os.environ.get("TPUDL_TRACE_RING", "")
+                           or _DEFAULT_RING)
+            except ValueError:
+                ring = _DEFAULT_RING
+        self._spans: deque[Span] = deque(maxlen=max(1, int(ring)))
+        self._lock = threading.Lock()
+        self.dropped = 0  # spans pushed out of the ring
+        # (start_us, end_us) of the most recent obs.profile capture —
+        # set by tpudl.obs.trace.profile so exports can window to it
+        self.last_profile_window: tuple[float, float] | None = None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException as e:
+            attrs = dict(attrs)
+            attrs["error"] = type(e).__name__
+            raise
+        finally:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            # epoch stamp taken LIVE at span end (duration still from
+            # the monotonic clock): a frozen import-time anchor would
+            # drift from profile()'s time.time() window across suspend
+            # or NTP steps, silently emptying window="profile" exports
+            ts_us = time.time() * 1e6 - dur_us
+            th = threading.current_thread()
+            s = Span(name, ts_us, dur_us, th.ident or 0, th.name,
+                     attrs or None)
+            with self._lock:
+                if len(self._spans) == self._spans.maxlen:
+                    self.dropped += 1
+                self._spans.append(s)
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def to_events(self, window: tuple[float, float] | None = None,
+                  ) -> list[dict]:
+        """Chrome trace-event list: process/thread metadata + one "X"
+        event per span, epoch-µs timestamps. ``window=(start_us,
+        end_us)`` keeps only spans overlapping it — the ring outlives
+        any one capture, and merging a device trace against
+        pre-capture spans would mis-attribute overlap."""
+        pid = os.getpid()
+        spans = self.spans()
+        if window is not None:
+            w0, w1 = window
+            spans = [s for s in spans
+                     if s.ts_us + s.dur_us >= w0 and s.ts_us <= w1]
+        events = [{"ph": "M", "pid": pid, "name": "process_name",
+                   "args": {"name": "tpudl host"}}]
+        seen_tids = {}
+        for s in spans:
+            if s.tid not in seen_tids:
+                seen_tids[s.tid] = s.thread_name
+        for tid, tname in sorted(seen_tids.items()):
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name", "args": {"name": tname}})
+        events.extend(s.to_event(pid) for s in spans)
+        return events
+
+    def export_chrome_trace(self, path: str,
+                            window: object = None) -> str:
+        """Write the ring as ``{"traceEvents": [...]}`` JSON. Name the
+        file ``*.host.trace.json`` so the CLI's directory scan finds it
+        next to the profiler's ``*.trace.json.gz``.
+
+        ``window="profile"`` keeps only spans overlapping the most
+        recent ``obs.profile`` capture (the merged-timeline workflow —
+        without it a long-lived process exports its whole ring and the
+        merge attributes overlap to pre-capture spans); an explicit
+        ``(start_us, end_us)`` tuple windows arbitrarily; None exports
+        everything."""
+        if window == "profile":
+            window = self.last_profile_window
+        payload = {"traceEvents": self.to_events(window=window),
+                   "displayTimeUnit": "ms",
+                   "metadata": {"tpudl": "host-span-tracer",
+                                "dropped_spans": self.dropped}}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def span(name: str, **attrs):
+    """``with obs.span("ml.Featurizer.transform", rows=n):`` — record a
+    host span on the process-wide tracer."""
+    return _TRACER.span(name, **attrs)
+
+
+def export_chrome_trace(path: str, window: object = None) -> str:
+    return _TRACER.export_chrome_trace(path, window=window)

@@ -31,6 +31,8 @@ import numpy as np
 
 from tpudl import distributed as D
 from tpudl import mesh as M
+from tpudl.obs import metrics as _obs_metrics
+from tpudl.obs import tracer as _obs_tracer
 from tpudl.train.checkpoint import CheckpointManager
 from tpudl.train.step import make_train_step
 
@@ -108,12 +110,17 @@ class HorovodRunner:
         while True:
             ctx.attempt = attempt
             try:
-                with M.use_mesh(mesh):
-                    return main(ctx, **kwargs)
+                with _obs_tracer.span("train.run", attempt=attempt,
+                                      mesh_size=ctx.size):
+                    with M.use_mesh(mesh):
+                        return main(ctx, **kwargs)
             except Exception:
                 attempt += 1
                 if attempt > self.max_restarts:
                     raise
+                # restart count is a first-class metric (a silently
+                # restarting gang looks healthy in logs-only setups)
+                _obs_metrics.counter("train.restarts").inc()
                 log.exception(
                     "train_fn failed; gang restart %d/%d from last "
                     "checkpoint", attempt, self.max_restarts)
@@ -272,8 +279,12 @@ class Trainer:
             # back with the same (possibly TP-sharded) shardings
             like = {"params": params, "opt_state": opt_state,
                     "step": np.asarray(0, np.int64)}
+            t_ck = time.perf_counter()
             restored = mgr.restore(like=like)
             if restored is not None:
+                _obs_metrics.histogram(
+                    "train.checkpoint_restore_seconds").observe(
+                        time.perf_counter() - t_ck)
                 params = restored["params"]
                 opt_state = restored["opt_state"]
                 start = int(restored["step"])
@@ -297,9 +308,17 @@ class Trainer:
                         and self.mesh.shape[M.DATA_AXIS] > 1)
         t0 = time.perf_counter()
         examples = 0
-        loss = None
+        executed = 0  # steps actually run (a failed run must not
+        loss = None   # report the PLANNED count to the registry)
+        # per-step loop time (dispatch cadence: async device dispatch
+        # returns early, so this is the host loop's view — the honest
+        # wall denominator is examples_per_sec in history) and
+        # checkpoint save durations, published run-wide
+        step_hist = _obs_metrics.histogram("train.step_seconds")
+        ckpt_hist = _obs_metrics.histogram("train.checkpoint_save_seconds")
         try:
             for step in range(start, steps):
+                t_step = time.perf_counter()
                 batch = data_fn(step)
                 if not isinstance(batch, tuple):
                     batch = (batch,)
@@ -310,12 +329,16 @@ class Trainer:
                 elif shard_inputs:
                     batch = tuple(M.shard_batch(b, self.mesh) for b in batch)
                 params, opt_state, loss = step_fn(params, opt_state, *batch)
+                step_hist.observe(time.perf_counter() - t_step)
+                executed += 1
                 examples += int(np.shape(batch[0])[0])
                 done = step + 1
                 if mgr is not None and done < steps:
+                    t_ck = time.perf_counter()
                     if mgr.maybe_save(done, {"params": params,
                                              "opt_state": opt_state,
                                              "step": np.asarray(done, np.int64)}):
+                        ckpt_hist.observe(time.perf_counter() - t_ck)
                         log.debug("checkpoint at step %d", done)
                 if self.log_every and done % self.log_every == 0:
                     dt = time.perf_counter() - t0
@@ -332,9 +355,14 @@ class Trainer:
                     {"step": steps, "loss": float(jax.device_get(loss)),
                      "examples_per_sec": examples / max(dt, 1e-9)})
             if mgr is not None and steps > start:
+                t_ck = time.perf_counter()
                 mgr.save(steps, {"params": params, "opt_state": opt_state,
                                  "step": np.asarray(steps, np.int64)}, force=True)
+                ckpt_hist.observe(time.perf_counter() - t_ck)
         finally:
             if mgr is not None:
                 mgr.close()
+            _obs_metrics.counter("train.steps").inc(executed)
+            _obs_metrics.counter("train.examples").inc(examples)
+            _obs_metrics.get_registry().maybe_flush()
         return params, opt_state, self.history

@@ -20,6 +20,8 @@ signature parity and ignored.
 
 from __future__ import annotations
 
+from tpudl.obs import metrics as _obs_metrics
+from tpudl.obs import tracer as _obs_tracer
 from tpudl.udf.registry import UDF, register_udf
 
 __all__ = ["makeGraphUDF"]
@@ -99,12 +101,20 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
     jfn = jax.jit(first_fetch)
 
     def frame_fn(frame):
-        # map_batches's default pack already stacks numeric and
-        # object-of-array columns (frame._default_pack)
-        return frame.map_batches(
-            jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh,
-            prefetch_depth=prefetch_depth, prepare_workers=prepare_workers,
-            fuse_steps=fuse_steps)
+        # per-UDF observability: calls/rows counters + a latency
+        # histogram + a host span, named by the registered udf_name so
+        # a SQL query's cost is attributable from one snapshot
+        with _obs_metrics.timed(f"udf.{udf_name}.seconds"), \
+                _obs_tracer.span(f"udf.{udf_name}", rows=len(frame)):
+            # map_batches's default pack already stacks numeric and
+            # object-of-array columns (frame._default_pack)
+            out = frame.map_batches(
+                jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh,
+                prefetch_depth=prefetch_depth,
+                prepare_workers=prepare_workers, fuse_steps=fuse_steps)
+        _obs_metrics.counter(f"udf.{udf_name}.calls").inc()
+        _obs_metrics.counter(f"udf.{udf_name}.rows").inc(len(frame))
+        return out
 
     if register:
         return register_udf(udf_name, frame_fn, in_cols[0], out_col)
