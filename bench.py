@@ -184,6 +184,13 @@ def _compact_summary(record: dict) -> dict:
             if v is None and key == "decode":
                 v = sub.get("pil_images_per_sec")
             s[key] = _scalar(v)
+    dp = record.get("data_pipeline") or {}
+    for k in ("u8_wire_shrink", "u8_speedup", "cache_warm_speedup",
+              "cache_warm_files_read"):
+        if dp.get(k) is not None:
+            # the tpudl.data one-line evidence: u8 ships ~4x fewer
+            # bytes; a warm epoch reads ZERO files
+            s[k] = _scalar(dp[k])
     if "full_record_path" in record:
         s["full_record"] = record["full_record_path"]
     return s
@@ -1119,6 +1126,124 @@ def measure_decode():
     return out
 
 
+def measure_data_pipeline():
+    """tpudl.data sub-bench (DATA.md): (a) a wire-codec A/B — the SAME
+    jitted reduction over float32 image batches, shipped identity vs u8
+    vs bf16, trials interleaved and bracketed by the 8 MB wire probe so
+    the arm comparison is attributable under tunnel weather (the
+    measure_featurize discipline); (b) shard-cache cold/warm epochs
+    over real JPEG files — epoch 1 decodes + persists, epoch 2 replays
+    memory-mapped shards with ZERO decodes (asserted off the decode
+    counters, recorded in the trial's obs snapshot). The wire-byte
+    counters (data.wire.bytes_shipped/dense) ride into the record, so
+    the u8 shrink is auditable, not inferred."""
+    import tempfile as _tempfile
+
+    import jax
+
+    from tpudl import obs
+    from tpudl.frame import Frame
+    from tpudl.image import imageIO
+
+    n = int(os.environ.get("TPUDL_BENCH_DATA_N", "512"))
+    batch = 64
+    h = w = 128
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 256, size=(n, h, w, 3), dtype=np.uint8)
+    f32 = u8.astype(np.float32) * np.float32(1.0 / 255.0)
+    col = np.empty(n, dtype=object)
+    col[:] = list(f32)
+    frame = Frame({"x": col})
+    # light compute on purpose: the arm difference is the WIRE
+    fn = jax.jit(lambda x: x.reshape(x.shape[0], -1).mean(axis=1))
+    out = {"n": n, "image_hw": h, "batch": batch}
+
+    def one_pass(codec):
+        t0 = time.perf_counter()
+        res = frame.map_batches(fn, ["x"], ["y"], batch_size=batch,
+                                wire_codec=codec)
+        np.asarray(res["y"])  # materialized
+        return n / (time.perf_counter() - t0)
+
+    arms = {"identity": [], "u8": [], "bf16": []}
+    shrink = {}
+    for arm in arms:  # compile each arm's wrapped program OUTSIDE timing
+        one_pass(arm)
+    for _t in range(2):
+        for arm in arms:
+            before = obs.snapshot()
+            bw_pre = _quiet_wire_probe()
+            rate = one_pass(arm)
+            after = obs.snapshot()
+
+            def delta(name):
+                return (after.get(name, {}).get("value", 0)
+                        - before.get(name, {}).get("value", 0))
+
+            shipped = delta("data.wire.bytes_shipped")
+            dense = delta("data.wire.bytes_dense")
+            shrink[arm] = round(dense / shipped, 2) if shipped else None
+            arms[arm].append(rate)
+            log(f"data codec arm [{arm}]: {rate:.1f} img/s "
+                f"(wire shrink {shrink[arm]}x, H2D probe {bw_pre} MB/s)")
+    med = {arm: round(statistics.median(r), 1) for arm, r in arms.items()}
+    out["codec_images_per_sec"] = med
+    out["codec_wire_shrink"] = shrink
+    out["u8_wire_shrink"] = shrink.get("u8")
+    if med.get("identity"):
+        out["u8_speedup"] = round(med["u8"] / med["identity"], 2)
+
+    # -- shard cache: cold decode+persist vs warm mmap replay ------------
+    k = int(os.environ.get("TPUDL_BENCH_DATA_FILES", "192"))
+    from PIL import Image
+
+    pack = lambda sl: np.stack(  # noqa: E731
+        [imageIO.imageStructToArray(r, copy=False) for r in sl])
+    pack.thread_safe = True
+    with _tempfile.TemporaryDirectory() as d:
+        img_dir = os.path.join(d, "imgs")
+        os.makedirs(img_dir)
+        base = rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
+        for i in range(k):
+            Image.fromarray(np.roll(base, i, axis=0)).save(
+                os.path.join(img_dir, f"im{i:04d}.jpg"), quality=85)
+        cache_dir = os.path.join(d, "cache")
+
+        def epoch():
+            files = imageIO.readImages(img_dir)
+            before = obs.snapshot()
+            t0 = time.perf_counter()
+            res = files.map_batches(fn, ["image"], ["y"], batch_size=batch,
+                                    pack=pack, wire_codec="u8",
+                                    cache_dir=cache_dir)
+            np.asarray(res["y"])
+            dt = time.perf_counter() - t0
+            after = obs.snapshot()
+            reads = (after.get("imageio.files_read", {}).get("value", 0)
+                     - before.get("imageio.files_read", {}).get("value", 0))
+            return dt, reads
+
+        hits_before = obs.snapshot().get("data.cache.hits",
+                                         {}).get("value", 0)
+        cold_s, cold_reads = epoch()
+        warm_s, warm_reads = epoch()
+        out["cache_cold_seconds"] = round(cold_s, 3)
+        out["cache_warm_seconds"] = round(warm_s, 3)
+        out["cache_cold_files_read"] = int(cold_reads)
+        out["cache_warm_files_read"] = int(warm_reads)  # contract: 0
+        out["cache_warm_speedup"] = (round(cold_s / warm_s, 2)
+                                     if warm_s > 0 else None)
+        # delta, not the absolute process-wide counter: earlier
+        # sub-benches' cache traffic must not inflate this record
+        out["cache_hits"] = obs.snapshot().get(
+            "data.cache.hits", {}).get("value", 0) - hits_before
+        log(f"data cache epochs ({k} JPEGs): cold {cold_s:.2f}s "
+            f"({cold_reads:.0f} reads) vs warm {warm_s:.2f}s "
+            f"({warm_reads:.0f} reads) -> "
+            f"{out['cache_warm_speedup']}x")
+    return out
+
+
 def measure_flash_attention():
     """Pallas flash-attention kernel vs dense XLA attention on the live
     backend (causal, H=8, D=128) at an S-SCALING ladder — round-3
@@ -1516,13 +1641,14 @@ def main():
         # so round-over-round swings in these rows are attributable to
         # tunnel weather INSIDE the same record
         probed = {"horovod_resnet50", "predictor_resnet50",
-                  "estimator_inception"}
+                  "estimator_inception", "data_pipeline"}
         for key, fn in [("horovod_resnet50", lambda: measure_train_step(dtype)),
                         ("predictor_resnet50", lambda: measure_predictor(dtype)),
                         ("keras_transformer_mlp", measure_keras_transformer),
                         ("estimator", measure_estimator_fit),
                         ("estimator_inception", measure_estimator_inception),
                         ("decode", measure_decode),
+                        ("data_pipeline", measure_data_pipeline),
                         ("flash_attention", measure_flash_attention)]:
             if not _gate(extra, key):
                 continue

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Integrity audit for tpudl.data shard-cache directories.
+
+The offline twin of ``tools/validate_metrics.py`` (wired into tier-1
+the same way — tests/test_data_shards.py loads this module and drives
+it over real and deliberately-corrupted caches): given a cache
+directory it finds every key directory with a ``manifest.json``, checks
+the manifest schema, and verifies each shard file — existence, byte
+size, crc32, and an ``.npy`` header that matches the manifest's
+dtype/shape. Exit 0 = every shard in every manifest is intact.
+
+Layout audited (written by :mod:`tpudl.data.shards`):
+
+    <cache_dir>/<key>/manifest.json
+    <cache_dir>/<key>/shard-000000-c0.npy ...
+
+Pure stdlib + numpy, importable (``from validate_shards import
+validate_cache_dir``) and runnable
+(``python tools/validate_shards.py <cache_dir>``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+_FILE_KEYS = {"name": str, "crc32": int, "nbytes": int,
+              "shape": list, "dtype": str}
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _npy_header(path: str):
+    """(shape, dtype_str) from an .npy header without loading data, or
+    raise ValueError."""
+    import numpy.lib.format as npf
+
+    with open(path, "rb") as f:
+        version = npf.read_magic(f)
+        if version == (1, 0):
+            shape, fortran, dtype = npf.read_array_header_1_0(f)
+        elif version == (2, 0):
+            shape, fortran, dtype = npf.read_array_header_2_0(f)
+        else:  # pragma: no cover - future npy versions
+            shape, fortran, dtype = npf._read_array_header(f, version)
+    return list(shape), str(dtype)
+
+
+def validate_manifest(mdir: str) -> tuple[list[str], int, int]:
+    """(errors, n_shards, n_files) for one key directory's manifest."""
+    errs: list[str] = []
+    path = os.path.join(mdir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable manifest ({e})"], 0, 0
+    if not isinstance(m, dict):
+        return [f"{path}: manifest is not a JSON object"], 0, 0
+    if m.get("version") != MANIFEST_VERSION:
+        errs.append(f"{path}: version {m.get('version')!r} != "
+                    f"{MANIFEST_VERSION}")
+    if not isinstance(m.get("key"), str):
+        errs.append(f"{path}: key missing or non-string")
+    shards = m.get("shards")
+    if not isinstance(shards, dict):
+        return errs + [f"{path}: shards missing or not an object"], 0, 0
+    meta = m.get("meta")
+    if meta is not None and not isinstance(meta, dict):
+        errs.append(f"{path}: meta is not an object")
+    n_files = 0
+    for k in sorted(shards, key=lambda s: (len(s), s)):
+        entry = shards[k]
+        where = f"{path}: shard {k}"
+        if not k.lstrip("-").isdigit():
+            errs.append(f"{where}: non-integer shard index")
+            continue
+        if not isinstance(entry, dict) or not isinstance(
+                entry.get("files"), list):
+            errs.append(f"{where}: entry must be an object with files[]")
+            continue
+        for fmeta in entry["files"]:
+            n_files += 1
+            if not isinstance(fmeta, dict):
+                errs.append(f"{where}: file entry is not an object")
+                continue
+            bad_schema = False
+            for fk, ft in _FILE_KEYS.items():
+                if not isinstance(fmeta.get(fk), ft):
+                    errs.append(f"{where}: file key {fk!r} missing or "
+                                f"not {ft.__name__}")
+                    bad_schema = True
+            if bad_schema:
+                continue
+            fpath = os.path.join(mdir, fmeta["name"])
+            try:
+                size = os.stat(fpath).st_size
+            except OSError:
+                errs.append(f"{where}: missing file {fmeta['name']}")
+                continue
+            if size != fmeta["nbytes"]:
+                errs.append(f"{where}: {fmeta['name']} size {size} != "
+                            f"manifest {fmeta['nbytes']} (truncated?)")
+                continue
+            if _crc32_file(fpath) != fmeta["crc32"]:
+                errs.append(f"{where}: {fmeta['name']} crc32 mismatch")
+                continue
+            try:
+                shape, dtype = _npy_header(fpath)
+            except Exception as e:
+                errs.append(f"{where}: {fmeta['name']} bad npy header "
+                            f"({e})")
+                continue
+            if shape != list(fmeta["shape"]) or dtype != fmeta["dtype"]:
+                errs.append(
+                    f"{where}: {fmeta['name']} header {dtype}{shape} != "
+                    f"manifest {fmeta['dtype']}{fmeta['shape']}")
+    return errs, len(shards), n_files
+
+
+def validate_cache_dir(root: str) -> tuple[list[str], int, int]:
+    """(errors, n_manifests, n_files) over every manifest under
+    ``root`` — ``root`` itself a key dir, or a cache dir of key dirs."""
+    manifests = []
+    if os.path.isfile(os.path.join(root, MANIFEST_NAME)):
+        manifests.append(root)
+    else:
+        try:
+            children = sorted(os.listdir(root))
+        except OSError as e:
+            return [f"{root}: unreadable ({e})"], 0, 0
+        for name in children:
+            sub = os.path.join(root, name)
+            if os.path.isfile(os.path.join(sub, MANIFEST_NAME)):
+                manifests.append(sub)
+    if not manifests:
+        return [f"{root}: no {MANIFEST_NAME} found"], 0, 0
+    errors, files = [], 0
+    for mdir in manifests:
+        errs, _n_shards, n_files = validate_manifest(mdir)
+        errors.extend(errs)
+        files += n_files
+    return errors, len(manifests), files
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: validate_shards.py <cache_dir>", file=sys.stderr)
+        return 2
+    errors, n_manifests, n_files = validate_cache_dir(argv[1])
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    print(f"{argv[1]}: {n_manifests} manifests, {n_files} shard files, "
+          f"{'OK' if not errors else str(len(errors)) + ' errors'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -35,6 +35,11 @@ _LAZY = {
     "registerKerasImageUDF": "tpudl.udf.keras_image_model",
     "GraphFunction": "tpudl.ingest",
     "IsolatedSession": "tpudl.ingest",
+    # wire-aware dataset subsystem (DATA.md)
+    "Dataset": "tpudl.data",
+    "U8Codec": "tpudl.data",
+    "BF16Codec": "tpudl.data",
+    "ShardCache": "tpudl.data",
     # long-context / sequence parallelism (TPU-native addition)
     "ring_attention": "tpudl.attention",
     "shard_sequence": "tpudl.attention",

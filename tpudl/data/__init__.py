@@ -1,0 +1,38 @@
+"""tpudl.data — the wire-aware dataset subsystem.
+
+The layer between image/ingest and the frame executor (DATA.md is the
+operator guide), three pillars:
+
+- :mod:`tpudl.data.codec` — **wire codecs**: shrink the host→device
+  representation (``u8``: uint8 pixels + scale/offset, 4× fewer bytes;
+  ``bf16``: 2×; ``identity``; ``auto`` picks from the measured wire)
+  and fuse a bit-controlled restoring prologue into the jitted model
+  program;
+- :mod:`tpudl.data.shards` — **sharded prepared-batch cache**:
+  checksummed, memory-mapped ``.npy`` shards with an atomic JSON
+  manifest; corruption re-prepares instead of crashing, epochs ≥ 2 and
+  repeat runs skip decode entirely;
+- :mod:`tpudl.data.dataset` — **Dataset facade**: epoch iteration with
+  replay, plus :func:`cached_uri_load` (the estimator's bulk-load
+  cache). ``Frame.map_batches(wire_codec=..., cache_dir=...)`` plumbs
+  the same machinery under every ml transformer and SQL UDF.
+"""
+
+from __future__ import annotations
+
+from tpudl.data.codec import (BF16Codec, CodecError, CodecPlan,
+                              IdentityCodec, U8Codec, WireCodec,
+                              codec_from_key, probe_wire_mbps,
+                              resolve_codec)
+from tpudl.data.dataset import Dataset, cached_uri_load
+from tpudl.data.shards import ShardCache, ShardCorruption, cache_key
+
+__all__ = [
+    # codecs
+    "WireCodec", "IdentityCodec", "U8Codec", "BF16Codec", "CodecError",
+    "CodecPlan", "resolve_codec", "codec_from_key", "probe_wire_mbps",
+    # shard cache
+    "ShardCache", "ShardCorruption", "cache_key",
+    # facade
+    "Dataset", "cached_uri_load",
+]

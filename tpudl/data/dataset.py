@@ -1,0 +1,261 @@
+"""Dataset facade: epoch iteration with replay over prepared batches.
+
+The user-facing face of the ``tpudl.data`` subsystem, sitting between
+the image/ingest layer and the frame executor (tf.data's 'input is a
+first-class optimizable pipeline' stance, Murray et al. 2021):
+
+    ds = Dataset(frame, ["image"], batch_size=256,
+                 wire_codec="auto", cache_dir="/tmp/tpudl-cache")
+    for epoch in range(3):
+        for batch, in ds.iter_epoch(epoch):
+            step(params, ds.device_restore(batch))
+
+Epoch 0 decodes/packs/encodes each batch (and persists it to the
+sharded cache when ``cache_dir`` is set); every later epoch — and every
+later RUN over the same inputs — replays memory-mapped shards with zero
+decodes. ``Frame.map_batches(wire_codec=..., cache_dir=...)`` plumbs the
+same machinery under the ml transformers; this facade is for custom
+loops (the estimator's bulk load rides :func:`cached_uri_load`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ["Dataset", "cached_uri_load"]
+
+
+def _callable_token(fn) -> str:
+    """Cache identity of a callable: an explicit ``cache_token`` beats
+    everything; otherwise module|qualname. The ONE implementation —
+    imageIO's decode/transform tokens and the loader token below all
+    route here, so cache identity can never drift between the
+    readImages and keras_image paths (DATA.md documents the
+    ``cache_token`` opt-in for custom callables whose code changes
+    under a stable name)."""
+    tok = getattr(fn, "cache_token", None)
+    if tok:
+        return str(tok)
+    return "|".join((getattr(fn, "__module__", "?"),
+                     getattr(fn, "__qualname__", repr(fn))))
+
+
+def _loader_token(loader) -> str:
+    """Loader cache identity: :func:`_callable_token` + the declared
+    wire attrs (createNativeImageLoader sets an explicit cache_token
+    from its geometry/scale/dtype)."""
+    tok = getattr(loader, "cache_token", None)
+    if tok:
+        return str(tok)
+    return "|".join([
+        _callable_token(loader),
+        str(getattr(loader, "output_dtype", "")),
+        str(getattr(loader, "wire_scale", "")),
+        str(getattr(loader, "wire_offset", "")),
+    ])
+
+
+def _uri_fingerprint(uris) -> str:
+    """sha1 over (path, size, mtime) per URI — a rewritten or reordered
+    file set re-keys the cache instead of replaying stale pixels."""
+    h = hashlib.sha1()
+    for u in uris:
+        h.update(str(u).encode())
+        try:
+            st = os.stat(u)
+            h.update(f"|{st.st_size}|{st.st_mtime_ns}".encode())
+        except OSError:
+            h.update(b"|?")
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def cached_uri_load(loader, uris, cache_dir: str, *,
+                    chunk: int = 256) -> np.ndarray:
+    """``load_uri_batch`` with a sharded on-disk cache: the URI list is
+    decoded in ``chunk``-sized shards, each persisted checksummed; a
+    repeat call over the same files (estimator re-fit, next epoch of a
+    multi-epoch sweep) performs ZERO decodes. Returns one stacked array
+    (float32, or uint8 for a loader that declares
+    ``output_dtype='uint8'`` — see imageIO.createNativeImageLoader)."""
+    from tpudl.data.shards import ShardCache, cache_key
+    from tpudl.ml.image_params import load_uri_batch
+
+    uris = list(uris)
+    key = cache_key(_uri_fingerprint(uris), loader=_loader_token(loader),
+                    chunk=int(chunk), layout="uri_load_v1")
+    cache = ShardCache(cache_dir, key)
+    parts = []
+    for start in range(0, len(uris), chunk):
+        idx = start // chunk
+        hit = cache.get(idx)
+        if hit is not None:
+            parts.append(hit[0])
+            continue
+        batch = load_uri_batch(loader, uris[start:start + chunk])
+        cache.put(idx, [batch])
+        parts.append(batch)
+    cache.flush()  # persist any throttled manifest entries
+    if not parts:
+        return load_uri_batch(loader, [])  # canonical empty shape
+    if len(parts) == 1:
+        return np.asarray(parts[0])
+    return np.concatenate([np.asarray(p) for p in parts], axis=0)
+
+
+class Dataset:
+    """Epoch-replayable prepared-batch view of a Frame's input columns.
+
+    Each yielded batch is the tuple of WIRE-encoded arrays the executor
+    would ship (one per column); :meth:`device_restore` (host) or
+    :meth:`wrap` (fused into a jitted fn) restore model-ready float32.
+    With ``cache_dir``, batches persist across epochs AND processes;
+    without it, epoch ≥ 1 replays from a bounded in-memory list when
+    ``retain=True`` (default: re-prepare — unbounded retention is an
+    explicit choice, not a surprise).
+    """
+
+    def __init__(self, frame, input_cols, *, batch_size: int = 256,
+                 wire_codec=None, cache_dir: str | None = None,
+                 pack=None, cache_key_material: str | None = None,
+                 retain: bool = False):
+        from tpudl.data import codec as _codec
+
+        self._frame = frame
+        self._cols = list(input_cols)
+        missing = [c for c in self._cols if c not in frame]
+        if missing:
+            raise KeyError(f"unknown input columns {missing}")
+        self._batch = max(1, int(batch_size))
+        self._pack = pack
+        self._plan = (_codec.CodecPlan(wire_codec, len(self._cols))
+                      if wire_codec is not None else None)
+        self._retain = bool(retain) and cache_dir is None
+        self._resolving = False  # wrap()'s probe: no wire accounting
+        self._memory: dict[int, tuple] = {}
+        self._cache = None
+        if cache_dir is not None:
+            from tpudl.data.shards import ShardCache, cache_key
+
+            material = (cache_key_material
+                        if cache_key_material is not None
+                        else frame.fingerprint(self._cols))
+            key = cache_key(material, cols=",".join(self._cols),
+                            batch=self._batch,
+                            codec=_codec.spec_token(wire_codec),
+                            layout="dataset_v1")
+            self._cache = ShardCache(cache_dir, key)
+            if self._plan is not None and self._cache.meta.get("codecs"):
+                self._plan.adopt(self._cache.meta["codecs"])
+
+    # -- shape -------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._frame)
+
+    @property
+    def num_batches(self) -> int:
+        return -(-len(self._frame) // self._batch)
+
+    @property
+    def cache(self):
+        return self._cache
+
+    @property
+    def plan(self):
+        return self._plan
+
+    # -- prepare one batch -------------------------------------------------
+    def _prepare(self, index: int) -> tuple:
+        from tpudl.frame.frame import _default_pack
+
+        start = index * self._batch
+        stop = min(start + self._batch, len(self._frame))
+        arrays = []
+        for col, name in enumerate(self._cols):
+            sl = self._frame[name][start:stop]
+            arr = (self._pack(sl) if self._pack is not None
+                   else _default_pack(sl))
+            if self._plan is not None:
+                arr = self._plan.encode(col, arr)
+            arrays.append(arr)
+        return tuple(arrays)
+
+    def get_batch(self, index: int) -> tuple:
+        """One prepared (encoded) batch by index: cache → memory →
+        prepare (+persist)."""
+        if self._cache is not None:
+            hit = self._cache.get(index)
+            # an all-hits replay still needs resolved codecs for the
+            # restore; a cache whose writer died before persisting its
+            # codec meta re-prepares (the frame.py prepare() guard)
+            if hit is not None and (self._plan is None
+                                    or self._plan.resolved()):
+                if self._plan is not None and not self._resolving:
+                    self._plan.record_shipped(hit)
+                return tuple(hit)
+        elif index in self._memory:
+            batch = self._memory[index]
+            if self._plan is not None and not self._resolving:
+                self._plan.record_shipped(batch)
+            return batch
+        batch = self._prepare(index)
+        if self._plan is not None and not self._resolving:
+            self._plan.record_shipped(batch)
+        if self._cache is not None:
+            self._cache.put(index, batch)
+            if self._plan is not None and self._plan.resolved() \
+                    and not self._cache.meta.get("codecs"):
+                self._cache.set_meta({"codecs": self._plan.keys()})
+        elif self._retain:
+            self._memory[index] = batch
+        return batch
+
+    def iter_epoch(self, epoch: int = 0):
+        """Yield every prepared batch in order. ``epoch`` only labels
+        the obs span — batch content and order are epoch-invariant
+        (shuffling belongs to the consumer, as in the estimator's
+        index permutation)."""
+        from tpudl.obs import tracer as _tracer
+
+        with _tracer.span("data.epoch", epoch=int(epoch),
+                          batches=self.num_batches):
+            try:
+                for i in range(self.num_batches):
+                    yield self.get_batch(i)
+            finally:
+                if self._cache is not None:  # persist throttled entries
+                    self._cache.flush()
+
+    def epochs(self, n: int):
+        for e in range(int(n)):
+            yield e, self.iter_epoch(e)
+
+    # -- restore -----------------------------------------------------------
+    def device_restore(self, batch: tuple):
+        """Host-side restore of one encoded batch (numpy; for host
+        consumers and tests). Device consumers should :meth:`wrap`
+        their jitted fn instead so the restore fuses on device."""
+        if self._plan is None:
+            return batch
+        return tuple(
+            c.decode_array(np.asarray(a)) for c, a in zip(
+                self._plan._codecs, batch))
+
+    def wrap(self, fn):
+        """``fn`` with the device prologues fused in front (see
+        CodecPlan.wrap); identity when no codec is configured."""
+        if self._plan is None:
+            return fn
+        if not self._plan.resolved():
+            # resolve from the first batch so wrap() works
+            # pre-iteration — as a PROBE: the epoch's own get_batch(0)
+            # is the one that counts toward the wire counters
+            self._resolving = True
+            try:
+                self.get_batch(0)
+            finally:
+                self._resolving = False
+        return self._plan.wrap(fn)

@@ -73,6 +73,15 @@ class LazyColumn:
         sources that can probe override (LazyFileColumn)."""
         return None
 
+    def fingerprint(self) -> str | None:
+        """Optional cheap content identity WITHOUT materializing values
+        — the prepared-batch cache (``map_batches(cache_dir=...)``)
+        keys on it so a changed source re-prepares instead of replaying
+        stale shards. None = unknown (the caller must supply an
+        explicit ``cache_key``); file-backed sources override
+        (LazyFileColumn hashes paths + sizes + mtimes)."""
+        return None
+
 
 class _SubsetLazyColumn(LazyColumn):
     def __init__(self, base: LazyColumn, indices: np.ndarray):
@@ -88,6 +97,15 @@ class _SubsetLazyColumn(LazyColumn):
     def validity_mask(self):
         base = self._base.validity_mask()
         return None if base is None else base[self._indices]
+
+    def fingerprint(self):
+        base = self._base.fingerprint()
+        if base is None:
+            return None
+        import hashlib
+
+        return hashlib.sha1(
+            base.encode() + self._indices.tobytes()).hexdigest()
 
 
 def _env_int(name: str, default: int) -> int:
@@ -383,6 +401,37 @@ class Frame:
     def to_dict(self) -> dict[str, np.ndarray]:
         return dict(self._cols)
 
+    def fingerprint(self, cols: Sequence[str] | None = None) -> str:
+        """Content identity of the named columns (sha1 hex) — the
+        prepared-batch cache's key material (``map_batches(cache_dir=
+        ...)``), so a changed input re-prepares instead of replaying
+        stale shards. Lazy columns answer via their cheap
+        ``fingerprint`` probe (LazyFileColumn: paths + sizes + mtimes —
+        NO reads, NO decodes); eager columns hash their bytes (only
+        paid when caching is on). A lazy column without a fingerprint
+        raises — pass ``cache_key`` explicitly for such sources."""
+        import hashlib
+
+        h = hashlib.sha1()
+        for name in (list(cols) if cols is not None else self.columns):
+            col = self._cols[name]
+            h.update(f"col:{name}\n".encode())
+            if isinstance(col, LazyColumn):
+                fp = col.fingerprint()
+                if fp is None:
+                    raise ValueError(
+                        f"lazy column {name!r} has no content "
+                        "fingerprint; pass an explicit cache_key= to "
+                        "map_batches/Dataset to enable caching")
+                h.update(str(fp).encode())
+            elif col.dtype == object:
+                for v in col:
+                    _hash_value(h, v)
+            else:
+                h.update(f"{col.dtype}{col.shape}".encode())
+                h.update(np.ascontiguousarray(col).tobytes())
+        return h.hexdigest()
+
     def rows(self) -> Iterator[dict]:
         for i in range(self._n):
             yield {k: v[i] for k, v in self._cols.items()}
@@ -410,6 +459,9 @@ class Frame:
         prepare_workers: int | None = None,
         fuse_steps: int | None = None,
         device_fn: bool | None = None,
+        wire_codec=None,
+        cache_dir: str | None = None,
+        cache_key: str | None = None,
     ) -> "Frame":
         """Run ``fn`` over the frame in device-sized batches; append outputs.
 
@@ -452,6 +504,22 @@ class Frame:
         once when a "host" fn returns device arrays.
         ``TPUDL_FRAME_PREFETCH=0`` force-disables the whole pipelined
         executor — prefetch AND fusion — for the bench A/B arm.
+
+        The ``tpudl.data`` knobs (DATA.md has the operator guide):
+
+        - ``wire_codec`` (env ``TPUDL_WIRE_CODEC``): a codec name
+          ('u8', 'bf16', 'identity', 'auto') or a
+          :class:`tpudl.data.WireCodec` — prepared batches are
+          wire-ENCODED host-side and a restoring prologue is fused in
+          front of ``fn`` on device, so an image batch ships as uint8
+          + scale instead of float32 (4× fewer H2D bytes). Device fns
+          only; a host fn gets a warn-once and the identity path.
+        - ``cache_dir`` (env ``TPUDL_DATA_CACHE_DIR``): prepared
+          (packed + encoded) batches persist to a checksummed sharded
+          cache keyed by the frame's content ``fingerprint`` — repeat
+          runs and epochs ≥ 2 over the same inputs skip decode/pack
+          entirely. ``cache_key`` overrides the fingerprint for frames
+          whose columns cannot self-identify (raises otherwise).
         """
         if batch_size is None:
             if self.num_partitions:
@@ -498,6 +566,60 @@ class Frame:
         from tpudl import obs  # deferred: host-only frames stay light
 
         report = obs.PipelineReport()
+
+        # -- tpudl.data: wire codec + sharded prepared-batch cache -------
+        if wire_codec is None:
+            wire_codec = os.environ.get("TPUDL_WIRE_CODEC") or None
+        if cache_dir is None:
+            cache_dir = os.environ.get("TPUDL_DATA_CACHE_DIR") or None
+        plan = cache = None
+        if wire_codec is not None or cache_dir is not None:
+            from tpudl.data import codec as _codec
+
+            if wire_codec is not None and not device_flag:
+                # a host fn's inputs must stay restored numpy — the
+                # device prologue can never run, so shipping encoded
+                # bytes would hand fn the wrong values
+                _codec.warn_host_fn_codec_once()
+                wire_codec = None
+            if wire_codec is not None:
+                plan = _codec.CodecPlan(wire_codec, len(input_cols),
+                                        report=report)
+            if cache_dir is not None:
+                from tpudl.data import shards as _shards
+
+                material = cache_key
+                if material is None:
+                    material = self.fingerprint(input_cols)
+                # the pack is part of the prepared bytes' identity: a
+                # different pack (e.g. a loader with another geometry)
+                # must re-key, not replay. A pack without an explicit
+                # ``cache_token`` keys by repr — object address, so the
+                # cache is only reused by the SAME pack object (never
+                # stale: two lambdas at one code location, or an edited
+                # function body, share a qualname but not an address).
+                # First-party packs carry tokens; set one on a custom
+                # pack to opt into cross-run reuse (DATA.md).
+                pack_token = ("default" if pack is None else
+                              getattr(pack, "cache_token", None)
+                              or repr(pack))
+                cache = _shards.ShardCache(
+                    cache_dir,
+                    _shards.cache_key(material,
+                                      cols=",".join(input_cols),
+                                      batch=int(batch_size),
+                                      codec=_codec.spec_token(wire_codec),
+                                      pack=pack_token,
+                                      # the sanitizer runs on the MISS
+                                      # path only; a run asking for it
+                                      # must not warm-skip the check
+                                      finite=bool(check_finite),
+                                      layout="map_batches_v1"))
+                if plan is not None and cache.meta.get("codecs"):
+                    # warm replay MUST restore with the codecs the
+                    # shards were encoded with, not a fresh auto pick
+                    plan.adopt(cache.meta["codecs"])
+
         report.config = {
             "executor": ("pipelined" if (prefetch or fuse > 1)
                          else "serial"),
@@ -508,6 +630,9 @@ class Frame:
             "fuse_steps": fuse,
             "batch_size": int(batch_size),
             "rows": self._n,
+            "wire_codec": (plan.names()[0] if plan is not None
+                           else "off"),
+            "batch_cache": bool(cache is not None),
         }
         obs.set_last_pipeline(report)
 
@@ -517,23 +642,58 @@ class Frame:
             is thread-safe and transfers release the GIL, so this
             overlaps the main thread's compute dispatch. The pool runs
             ``pack`` for DIFFERENT batches concurrently only when the
-            pack opted in (see the workers resolution above)."""
+            pack opted in (see the workers resolution above).
+
+            With a shard cache, a verified hit replaces the whole
+            pack/decode/encode path by a memory-mapped read; a miss (or
+            a corrupt shard) prepares as usual and persists the result.
+            Wire encoding happens AFTER pack and the finite check (the
+            check must see restored float values, not wire bytes)."""
             with report.stage("prepare"):
-                packed = []
-                for c in input_cols:
-                    sl = self._cols[c][start:stop]
-                    arr = pack(sl) if pack is not None else _default_pack(sl)
-                    if check_finite and np.issubdtype(arr.dtype, np.floating):
-                        # input-pipeline sanitizer (SURVEY.md §5.2): catch
-                        # bad rows host-side before they enter a fused
-                        # program
-                        bad = ~np.isfinite(arr).reshape(arr.shape[0], -1).all(1)
-                        if bad.any():
-                            rows = (np.nonzero(bad)[0][:8] + start).tolist()
-                            raise ValueError(
-                                f"non-finite values in column {c!r}, rows "
-                                f"{rows} (batch {start}:{stop})")
-                    packed.append(arr)
+                bidx = start // batch_size
+                packed = None
+                if cache is not None:
+                    hit = cache.get(bidx)
+                    # an all-hits replay still needs resolved codecs for
+                    # the device prologue; a cache written by a run that
+                    # died before persisting its codec meta re-prepares
+                    if hit is not None and (plan is None
+                                            or plan.resolved()):
+                        report.count("cache_hits")
+                        # device fns only read their numpy inputs, so
+                        # they keep the zero-copy read-only mmap; a
+                        # host fn may mutate in place (legal on the
+                        # cold path's fresh arrays), so warm batches
+                        # must be writable copies or cold/warm diverge
+                        packed = (list(hit) if device_flag
+                                  else [np.array(a) for a in hit])
+                if packed is None:
+                    if cache is not None:
+                        report.count("cache_misses")
+                    packed = []
+                    for ci, c in enumerate(input_cols):
+                        sl = self._cols[c][start:stop]
+                        arr = pack(sl) if pack is not None else _default_pack(sl)
+                        if check_finite and np.issubdtype(arr.dtype, np.floating):
+                            # input-pipeline sanitizer (SURVEY.md §5.2):
+                            # catch bad rows host-side before they enter
+                            # a fused program
+                            bad = ~np.isfinite(arr).reshape(arr.shape[0], -1).all(1)
+                            if bad.any():
+                                rows = (np.nonzero(bad)[0][:8] + start).tolist()
+                                raise ValueError(
+                                    f"non-finite values in column {c!r}, rows "
+                                    f"{rows} (batch {start}:{stop})")
+                        if plan is not None:
+                            arr = plan.encode(ci, arr)
+                        packed.append(arr)
+                    if cache is not None:
+                        cache.put(bidx, packed)
+                        if (plan is not None and plan.resolved()
+                                and not cache.meta.get("codecs")):
+                            cache.set_meta({"codecs": plan.keys()})
+                if plan is not None:
+                    plan.record_shipped(packed)
                 n_pad = 0
                 if mesh is not None:
                     # every column slices the same rows, so one pad count
@@ -618,6 +778,18 @@ class Frame:
             consumed += 1
             return out
 
+        run_fn = fn if plan is None else None
+
+        def _run_fn():
+            """``fn`` with the codec prologues fused in front (ONE jit
+            program, see CodecPlan.wrap) — bindable only after the
+            first batch prepared ('auto' codecs pick from it), hence
+            the lazy bind; identity plans return ``fn`` itself."""
+            nonlocal run_fn
+            if run_fn is None:
+                run_fn = plan.wrap(fn)
+            return run_fn
+
         t_wall = time.perf_counter()
         try:
             while consumed < len(spans):
@@ -631,10 +803,10 @@ class Frame:
                         # geometry pack): dispatch this group per-batch
                         for packed, n_pad in group:
                             with report.stage("dispatch"):
-                                result = fn(*packed)
+                                result = _run_fn()(*packed)
                             handle(result, n_pad)
                         continue
-                    fused_fn = _fused_wrapper(fn, fuse)
+                    fused_fn = _fused_wrapper(_run_fn(), fuse)
                     with report.stage("dispatch"):
                         result = fused_fn(*stacked)
                     report.count("fused_dispatches")
@@ -642,11 +814,13 @@ class Frame:
                 else:
                     packed, n_pad = next_prepared()
                     with report.stage("dispatch"):
-                        result = fn(*packed)
+                        result = _run_fn()(*packed)
                     handle(result, n_pad)
         finally:
             if infeed is not None:
                 infeed.close()
+            if cache is not None:
+                cache.flush()  # persist any throttled manifest entries
         while pending:
             with report.stage("d2h"):
                 _drain(pending.pop(0), outputs)
@@ -655,6 +829,10 @@ class Frame:
                 _fetch_accumulated(acc, segs, outputs)
         # close out the run: wall time + publish totals into the
         # process-wide metrics registry (obs.snapshot() / JSONL sink)
+        if plan is not None and plan.resolved():
+            # deferred specs ('auto'/'u8') now know their pick — the
+            # report shows what actually ran, not what was asked for
+            report.config["wire_codec"] = plan.names()[0]
         report.finish(time.perf_counter() - t_wall)
         out = self
         for name, chunks in zip(output_cols, outputs):
@@ -733,6 +911,27 @@ def null_mask(col) -> np.ndarray:
     if np.issubdtype(col.dtype, np.floating):
         return np.isnan(col)
     return np.zeros(len(col), dtype=bool)
+
+
+def _hash_value(h, v) -> None:
+    """One object-column row into a running hash — covers the column
+    shapes this frame actually stores (image structs, raw bytes,
+    ndarrays, scalars/strings, None); anything else contributes its
+    repr (best effort, documented in DATA.md)."""
+    if v is None:
+        h.update(b"\x00none")
+    elif isinstance(v, bytes):
+        h.update(b"\x00b")
+        h.update(v)
+    elif isinstance(v, dict):
+        for k in sorted(v):
+            h.update(f"\x00k{k}=".encode())
+            _hash_value(h, v[k])
+    elif isinstance(v, np.ndarray):
+        h.update(f"\x00a{v.dtype}{v.shape}".encode())
+        h.update(np.ascontiguousarray(v).tobytes())
+    else:
+        h.update(f"\x00r{v!r}".encode())
 
 
 def _default_pack(sl: np.ndarray) -> np.ndarray:

@@ -280,14 +280,27 @@ def default_probe(raw_bytes: bytes) -> bool:
 
 
 def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
-                            n_threads: int | None = None):
-    """Build a URI→ndarray ``imageLoader`` (float32 RGB, values in
-    [0, 255]·scale) whose ``batch_decode`` attribute routes a WHOLE URI
-    batch through one threaded native decode+resize call — the pack-stage
-    fast path ``load_uri_batch`` uses for
-    KerasImageFileTransformer/Estimator. Per-URI calls and non-JPEG files
-    fall back to PIL; a file failing both raises (the estimator path's
-    strictness).
+                            n_threads: int | None = None,
+                            output_dtype: str = "float32"):
+    """Build a URI→ndarray ``imageLoader`` whose ``batch_decode``
+    attribute routes a WHOLE URI batch through one threaded native
+    decode+resize call — the pack-stage fast path ``load_uri_batch``
+    uses for KerasImageFileTransformer/Estimator. Per-URI calls and
+    non-JPEG files fall back to PIL; a file failing both raises (the
+    estimator path's strictness).
+
+    ``output_dtype`` picks the WIRE representation (DATA.md):
+
+    - ``"float32"`` (default, unchanged numerics): eager
+      ``float32 * scale`` RGB in [0, 255]·scale — the identity-codec
+      fallback path;
+    - ``"uint8"``: raw uint8 RGB pixels with the ``* scale`` normalize
+      DEFERRED to the device — the loader declares
+      ``wire_scale``/``wire_offset`` and the ``u8`` wire codec's fused
+      prologue applies them (``f32(u8) * f32(scale)``: bit-identical
+      to the eager float32 path for uint8-sourced images, at 4× fewer
+      host→device bytes). KerasImageFileTransformer/Estimator install
+      that codec automatically when the loader declares uint8.
 
     ``n_threads`` (env ``TPUDL_DECODE_THREADS``; default: native layer
     picks min(batch, cpu_count)) caps the native decoder's thread count
@@ -297,6 +310,11 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
     too (reads release the GIL); everything here is thread-safe, so
     concurrent ``batch_decode`` calls from the executor's prepare
     workers are fine."""
+    if output_dtype not in ("float32", "uint8"):
+        raise ValueError(
+            f"output_dtype must be 'float32' or 'uint8', got "
+            f"{output_dtype!r}")
+    raw_u8 = output_dtype == "uint8"
     if n_threads is None:
         env = os.environ.get("TPUDL_DECODE_THREADS")
         try:
@@ -307,6 +325,8 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
     def _pil_one(uri: str) -> np.ndarray:
         img = Image.open(uri).convert("RGB").resize(
             (width, height), Image.BILINEAR)
+        if raw_u8:
+            return np.asarray(img, np.uint8)
         return np.asarray(img, np.float32) * scale
 
     def _read_all(uris: list) -> list:
@@ -314,10 +334,15 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
             with open(u, "rb") as f:
                 return f.read()
 
-        return _parallel_map(
+        raws = _parallel_map(
             _read, uris,
             _env_workers("TPUDL_FRAME_IO_WORKERS",
                          LazyFileColumn._IO_WORKERS))
+        if raws:  # same per-batch accounting as LazyFileColumn reads
+            _obs_metrics.counter("imageio.files_read").inc(len(raws))
+            _obs_metrics.counter("imageio.bytes_read").inc(
+                sum(len(r) for r in raws))
+        return raws
 
     def loader(uri: str) -> np.ndarray:
         from tpudl import native
@@ -328,7 +353,10 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
             batch, ok = native.decode_resize_batch(
                 [raw], height, width, n_threads=1)
             if ok[0]:
-                return batch[0][:, :, ::-1].astype(np.float32) * scale
+                rgb = batch[0][:, :, ::-1]
+                if raw_u8:
+                    return np.ascontiguousarray(rgb)
+                return rgb.astype(np.float32) * scale
         return _pil_one(uri)
 
     def batch_decode(uris) -> np.ndarray:
@@ -336,19 +364,30 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
 
         uris = list(uris)
         if not uris:
-            return np.zeros((0, height, width, 3), np.float32)
+            return np.zeros((0, height, width, 3),
+                            np.uint8 if raw_u8 else np.float32)
         if not native.available():
             return np.stack([_pil_one(u) for u in uris])
         raws = _read_all(uris)
         batch, ok = native.decode_resize_batch(raws, height, width,
                                                n_threads=n_threads)
-        out = batch[:, :, :, ::-1].astype(np.float32) * scale
+        rgb = batch[:, :, :, ::-1]
+        out = (np.ascontiguousarray(rgb) if raw_u8
+               else rgb.astype(np.float32) * scale)
         for i, good in enumerate(ok):
             if not good:
                 out[i] = _pil_one(uris[i])
         return out
 
     loader.batch_decode = batch_decode
+    # wire declaration the data layer reads: with raw uint8 output the
+    # deferred normalize (scale, offset) becomes the u8 codec's fused
+    # device prologue (tpudl.data.codec.U8Codec)
+    loader.output_dtype = output_dtype
+    loader.wire_scale = float(scale)
+    loader.wire_offset = 0.0
+    loader.cache_token = (f"native:{height}x{width}:s{scale!r}"
+                          f":{output_dtype}")
     # stateless over thread-safe substrates (fresh buffers per call;
     # libjpeg contexts are per-thread in decode.cpp): the executor's
     # prepare pool may run batch_decode for several batches at once
@@ -538,6 +577,24 @@ class LazyFileColumn(LazyColumn):
             self._validity = flags
         return self._validity
 
+    def fingerprint(self) -> str:
+        """Content identity WITHOUT reads or decodes (the Frame
+        ``fingerprint`` contract, consumed by the tpudl.data shard
+        cache): sha1 over each path + its size + mtime, plus the
+        transform's cache token — so a rewritten file, a reordered
+        listing, or a different decoder re-keys the cache instead of
+        replaying stale shards."""
+        import hashlib
+
+        from tpudl.data.dataset import _callable_token, _uri_fingerprint
+
+        h = hashlib.sha1()
+        if self._transform is not None:
+            h.update(
+                f"transform:{_callable_token(self._transform)}\n".encode())
+        h.update(_uri_fingerprint(self._paths).encode())
+        return h.hexdigest()
+
     def with_transform(self, transform: Callable,
                        probe: Callable | None = None) -> "LazyFileColumn":
         """Same paths, different per-file transform — how readImages
@@ -674,6 +731,11 @@ def readImagesWithCustomFn(path, decode_f, numPartition: int | None = None,
         # the serial-decode contract follows decode_f's own declaration
         # (default_decode is marked; custom decoders stay serialized)
         tr.thread_safe = bool(getattr(decode_f, "thread_safe", False))
+        # cache identity for the shard cache's frame fingerprint: a
+        # different decode_f must re-key cached prepared batches
+        from tpudl.data.dataset import _callable_token
+
+        tr.cache_token = "decode:" + _callable_token(decode_f)
         col = files["fileData"].with_transform(
             tr, probe=(lambda p, raw: probe_f(raw)) if probe_f else None)
         return Frame({"image": col}, num_partitions=numPartition)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from tpudl.ml.params import Param, Params
+from tpudl.obs import metrics as _obs_metrics
 
 __all__ = ["CanLoadImage", "load_uri_batch"]
 
@@ -30,24 +31,56 @@ class CanLoadImage(Params):
     def getImageLoader(self):
         return self.getOrDefault(self.imageLoader)
 
-    def loadImagesInternal(self, frame, inputCol: str):
-        """URI column → stacked float32 batch (N, H, W, C), loader-defined
-        geometry. Unloadable URIs raise — matching the estimator path's
-        strictness (the lenient null-row path is readImagesWithCustomFn)."""
-        return load_uri_batch(self.getImageLoader(), frame[inputCol])
+    def loadImagesInternal(self, frame, inputCol: str,
+                           cache_dir: str | None = None):
+        """URI column → stacked batch (N, H, W, C), loader-defined
+        geometry and dtype (float32, or raw uint8 for a loader that
+        declares ``output_dtype='uint8'`` — see
+        imageIO.createNativeImageLoader). Unloadable URIs raise —
+        matching the estimator path's strictness (the lenient null-row
+        path is readImagesWithCustomFn). With ``cache_dir`` the load
+        goes through the tpudl.data sharded cache
+        (:func:`tpudl.data.cached_uri_load`): a repeat fit over the
+        same files performs ZERO decodes."""
+        loader = self.getImageLoader()
+        uris = frame[inputCol]
+        if cache_dir is None:
+            # the same process-wide default map_batches honors — the
+            # estimator's bulk-load path must not silently ignore it
+            import os
+
+            cache_dir = os.environ.get("TPUDL_DATA_CACHE_DIR") or None
+        if cache_dir:
+            from tpudl.data import cached_uri_load
+
+            return cached_uri_load(loader, uris, cache_dir)
+        return load_uri_batch(loader, uris)
 
 
 def load_uri_batch(loader, uris) -> np.ndarray:
-    """Apply ``loader`` to each URI and stack into one float32 batch —
-    shared by the estimator's bulk load and the file-transformer's
-    per-batch pack stage.
+    """Apply ``loader`` to each URI and stack into one batch — shared by
+    the estimator's bulk load and the file-transformer's per-batch pack
+    stage. float32 unless the loader DECLARES raw-uint8 output
+    (``loader.output_dtype == 'uint8'``), in which case uint8 is
+    preserved so the u8 wire codec ships 4× fewer bytes (the deferred
+    ``* scale`` normalize runs on device — DATA.md).
 
     Loaders carrying a ``batch_decode`` attribute (e.g.
     ``imageIO.createNativeImageLoader``) get the whole batch in one call —
-    the threaded native decode+resize fast path."""
+    the threaded native decode+resize fast path.
+
+    ``imageio.uris_loaded`` counts every URI decoded here — the decode
+    counter cache-hit assertions read (a cached replay must leave it
+    unchanged)."""
+    uris = list(uris)
+    if uris:
+        _obs_metrics.counter("imageio.uris_loaded").inc(len(uris))
+    keep_u8 = getattr(loader, "output_dtype", None) == "uint8"
     batched = getattr(loader, "batch_decode", None)
     if batched is not None:
-        out = np.asarray(batched(uris), dtype=np.float32)
+        out = np.asarray(batched(uris))
+        if not (keep_u8 and out.dtype == np.uint8):
+            out = out.astype(np.float32, copy=False)
         if out.ndim != 4:
             raise ValueError(
                 f"batch_decode returned shape {out.shape}; expected "
@@ -62,9 +95,11 @@ def load_uri_batch(loader, uris) -> np.ndarray:
             raise ValueError(
                 f"imageLoader returned shape {arr.shape} for {uri!r}; "
                 "expected (H, W, C)")
-        arrays.append(arr.astype(np.float32))
+        if not (keep_u8 and arr.dtype == np.uint8):
+            arr = arr.astype(np.float32, copy=False)
+        arrays.append(arr)
     if not arrays:
-        return np.zeros((0, 1, 1, 1), np.float32)
+        return np.zeros((0, 1, 1, 1), np.uint8 if keep_u8 else np.float32)
     shapes = {a.shape for a in arrays}
     if len(shapes) > 1:
         raise ValueError(

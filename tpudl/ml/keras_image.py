@@ -30,7 +30,7 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
     def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
                  imageLoader=None, outputMode="vector", batchSize=64,
                  mesh=None, prefetchDepth=None, prepareWorkers=None,
-                 fuseSteps=None):
+                 fuseSteps=None, wireCodec=None, cacheDir=None):
         super().__init__()
         self._setDefault(outputMode="vector")
         self.batchSize = int(batchSize)
@@ -50,6 +50,13 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
             from tpudl.ml.image_params import load_uri_batch
 
             return load_uri_batch(loader, sl)
+
+        # the pack's cache identity IS the loader's (geometry, scale,
+        # dtype): a different loader over the same URI column must
+        # re-key the shard cache, not replay stale decodes
+        from tpudl.data.dataset import _loader_token
+
+        pack.cache_token = "uri_pack:" + _loader_token(loader)
 
         # concurrency is strictly opt-in (the LazyFileColumn contract):
         # only a loader that DECLARES itself thread-safe lets the
@@ -77,10 +84,42 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         out_col = self.getOutputCol()
         jfn = self._cached_jit(
             (model_file, os.path.getmtime(model_file), mode), build)
+        opts = self._pipeline_opts()
+        if getattr(loader, "output_dtype", None) == "uint8":
+            # a raw-uint8 loader DEFERS its `* scale` normalize to the
+            # device: the u8 codec's fused prologue is what applies it,
+            # so it installs by default (DATA.md) — without it the
+            # model would see un-normalized pixels. An explicit
+            # wireCodec that cannot carry the normalize (identity,
+            # bf16, bare 'u8'/'auto' which would infer scale=1) is a
+            # misconfiguration that must not silently feed the model
+            # 255x-too-large pixels; an explicit U8Codec INSTANCE is
+            # the user owning the scale.
+            from tpudl.data import U8Codec
+
+            if opts.get("wire_codec") is None:
+                opts["wire_codec"] = U8Codec(
+                    scale=getattr(loader, "wire_scale", 1.0),
+                    offset=getattr(loader, "wire_offset", 0.0))
+            elif not isinstance(opts["wire_codec"], U8Codec):
+                raise ValueError(
+                    f"imageLoader defers its normalize (output_dtype="
+                    f"'uint8', wire_scale={getattr(loader, 'wire_scale', 1.0)!r}) "
+                    f"but wireCodec={opts['wire_codec']!r} would skip it; "
+                    "drop wireCodec (the matching u8 codec installs "
+                    "automatically) or pass U8Codec(scale=...) explicitly")
+        if opts.get("cache_dir") or os.environ.get("TPUDL_DATA_CACHE_DIR"):
+            # URI columns name files the frame fingerprint cannot see
+            # into; key the cache on path+size+mtime so a rewritten
+            # image re-decodes instead of replaying stale pixels
+            from tpudl.data.dataset import _uri_fingerprint
+
+            opts["cache_key"] = _uri_fingerprint(
+                frame[self.getInputCol()])
         out = frame.map_batches(
             jfn, [self.getInputCol()], [out_col],
             batch_size=self.batchSize, mesh=self.mesh, pack=pack,
-            **self._pipeline_opts())
+            **opts)
         if mode == "image":
             structs = [
                 imageIO.imageArrayToStruct(np.asarray(a, dtype=np.float32))

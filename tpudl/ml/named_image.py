@@ -181,7 +181,8 @@ class DeepImageFeaturizer(_NamedImageTransformer):
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  weights="random", batchSize=64, mesh=None,
                  computeDtype="float32", prefetchDepth=None,
-                 prepareWorkers=None, fuseSteps=None):
+                 prepareWorkers=None, fuseSteps=None, wireCodec=None,
+                 cacheDir=None):
         super().__init__()
         self.weights = weights
         self.batchSize = int(batchSize)
@@ -215,7 +216,8 @@ class DeepImagePredictor(_NamedImageTransformer):
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  decodePredictions=False, topK=5, weights="random",
                  batchSize=64, mesh=None, computeDtype="float32",
-                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None):
+                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
+                 wireCodec=None, cacheDir=None):
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5)
         self.weights = weights

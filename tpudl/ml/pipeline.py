@@ -71,13 +71,18 @@ class Transformer(Params):
     def _pipeline_opts(self) -> dict:
         """The ``Frame.map_batches`` pipelined-executor knobs every
         batch transformer plumbs through: prefetch depth (K), prepare
-        workers (N), fused dispatch steps (M). None = resolve from the
-        ``TPUDL_FRAME_*`` env knobs / defaults inside map_batches, so a
-        transformer that never sets them still rides the pipeline."""
+        workers (N), fused dispatch steps (M), plus the tpudl.data
+        knobs — wire codec and prepared-batch cache dir (DATA.md).
+        None = resolve from the ``TPUDL_FRAME_*`` /
+        ``TPUDL_WIRE_CODEC`` / ``TPUDL_DATA_CACHE_DIR`` env knobs /
+        defaults inside map_batches, so a transformer that never sets
+        them still rides the pipeline."""
         return {
             "prefetch_depth": getattr(self, "prefetchDepth", None),
             "prepare_workers": getattr(self, "prepareWorkers", None),
             "fuse_steps": getattr(self, "fuseSteps", None),
+            "wire_codec": getattr(self, "wireCodec", None),
+            "cache_dir": getattr(self, "cacheDir", None),
         }
 
     def _set_pipeline_opts(self, kwargs: dict):
@@ -88,6 +93,8 @@ class Transformer(Params):
         self.prefetchDepth = kwargs.pop("prefetchDepth", None)
         self.prepareWorkers = kwargs.pop("prepareWorkers", None)
         self.fuseSteps = kwargs.pop("fuseSteps", None)
+        self.wireCodec = kwargs.pop("wireCodec", None)
+        self.cacheDir = kwargs.pop("cacheDir", None)
 
 
 class Model(Transformer):

@@ -111,7 +111,8 @@ class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
     def __init__(self, *, inputCol=None, outputCol=None, graph=None,
                  inputTensor=None, outputTensor=None, channelOrder="RGB",
                  outputMode="vector", batchSize=64, mesh=None,
-                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None):
+                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
+                 wireCodec=None, cacheDir=None):
         super().__init__()
         self._setDefault(channelOrder="RGB", outputMode="vector")
         self.batchSize = int(batchSize)
@@ -211,3 +212,6 @@ def _pack_image_structs(sl: np.ndarray) -> np.ndarray:
 # pure function of its slice: the executor's prepare pool may run it for
 # different batches concurrently (map_batches checks this marker)
 _pack_image_structs.thread_safe = True
+# stable cache identity: prepared bytes depend only on the struct
+# contents, which the frame fingerprint already covers
+_pack_image_structs.cache_token = "image_structs_v1"

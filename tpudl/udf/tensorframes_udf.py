@@ -33,7 +33,9 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
                  batch_size: int = 256, mesh=None,
                  prefetch_depth: int | None = None,
                  prepare_workers: int | None = None,
-                 fuse_steps: int | None = None) -> UDF:
+                 fuse_steps: int | None = None,
+                 wire_codec=None,
+                 cache_dir: str | None = None) -> UDF:
     """Register ``graph`` as a SQL UDF named ``udf_name``.
 
     ``graph``: a :class:`tpudl.ingest.TFInputGraph` (any factory route,
@@ -47,7 +49,10 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
     ``prefetch_depth`` / ``prepare_workers`` / ``fuse_steps`` plumb the
     ``Frame.map_batches`` pipelined-executor knobs (None = the
     ``TPUDL_FRAME_*`` env defaults), so SQL-registered models ride the
-    same staged pipeline as the ml transformers.
+    same staged pipeline as the ml transformers; ``wire_codec`` /
+    ``cache_dir`` plumb the tpudl.data knobs the same way (DATA.md —
+    wire-encoded uploads and the sharded prepared-batch cache), so a
+    SQL query over the same frame replays its prepared batches too.
 
     SQL's ``fn(col)`` grammar binds single-input graphs; multi-input
     graphs still register and are callable as ``udf(frame)`` with every
@@ -111,7 +116,8 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
             out = frame.map_batches(
                 jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh,
                 prefetch_depth=prefetch_depth,
-                prepare_workers=prepare_workers, fuse_steps=fuse_steps)
+                prepare_workers=prepare_workers, fuse_steps=fuse_steps,
+                wire_codec=wire_codec, cache_dir=cache_dir)
         _obs_metrics.counter(f"udf.{udf_name}.calls").inc()
         _obs_metrics.counter(f"udf.{udf_name}.rows").inc(len(frame))
         return out
