@@ -49,9 +49,51 @@ import time
 
 import numpy as np
 
+# keras/TF sub-benches: silence the C++ log flood BEFORE any tf import
+# (BENCH_r05's kept stderr tail was mostly TF log noise burying the
+# actual failure); absl needs a post-import call too (_silence_tf_logs)
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def _silence_tf_logs():
+    """Quiet absl + tf.logging (possible only AFTER import) — called at
+    the top of every keras-importing sub-bench so the stderr tail keeps
+    measurements, not retracing warnings. setdefault: an operator's
+    explicit TF_CPP_MIN_LOG_LEVEL=0 debug run stays loud."""
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    try:
+        from absl import logging as absl_logging
+
+        absl_logging.set_verbosity(absl_logging.ERROR)
+    except Exception:
+        pass
+    import logging
+
+    logging.getLogger("tensorflow").setLevel(logging.ERROR)
+
+
+def _arm_flight_recorder():
+    """Register the tpudl.obs flight recorder: dumps land next to the
+    full record (bench_records/), so an external kill — the BENCH_r05
+    rc=124 class — leaves a black box `python -m tpudl.obs doctor` can
+    classify, not just an stderr tail. The stall watchdog rides along
+    (a wedged backend RPC is flagged with thread stacks while the
+    process is still alive)."""
+    try:
+        from tpudl.obs import flight as _flight
+
+        os.environ.setdefault("TPUDL_FLIGHT_DIR", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_records"))
+        os.environ.setdefault("TPUDL_WATCHDOG_STALL_S", "300")
+        _flight.install()
+        return _flight
+    except Exception as e:
+        log(f"flight recorder install failed: {e!r}")
+        return None
 
 
 _EMITTED = threading.Event()
@@ -96,6 +138,17 @@ def _install_sigterm_flush(record: dict):
 
     def handler(signum, frame):
         log(f"signal {signum} received — flushing partial record")
+        try:
+            # black box FIRST: the dump is the forensic record the
+            # summary line can't carry. timeout= is mandatory here —
+            # the handler may have interrupted a frame holding an obs
+            # lock, so the snapshot runs on a worker thread and is
+            # abandoned (not deadlocked on) if it can't finish
+            from tpudl.obs import flight as _flight
+
+            _flight.dump(reason=f"signal:{signum}", timeout=10.0)
+        except Exception as e:
+            log(f"flight dump failed: {e!r}")
         if _EMIT_DONE.is_set():
             os._exit(0)  # summary already fully printed
         # Print the summary line DIRECTLY — not via _emit: the handler
@@ -254,6 +307,14 @@ def _start_watchdog(record: dict):
         if not _EMITTED.is_set():
             log(f"bench deadline {deadline:.0f}s hit — emitting partial "
                 "record and exiting (a backend RPC is likely wedged)")
+            try:
+                from tpudl.obs import flight as _flight
+
+                # a wedged main thread may hold an obs lock mid-RPC:
+                # bounded dump, same rationale as the SIGTERM path
+                _flight.dump(reason="bench_deadline", timeout=15.0)
+            except Exception as e:
+                log(f"flight dump failed: {e!r}")
             child = _ACTIVE_CHILD.get("proc")
             if child is not None and child.poll() is None:
                 child.kill()  # orphan would keep holding the chip
@@ -302,6 +363,7 @@ def run_featurize_trial(arm, n, batch, dtype):
     from tpudl.compilation_cache import enable_compilation_cache
     from tpudl.ml import DeepImageFeaturizer
 
+    _arm_flight_recorder()  # a killed trial leaves its own black box
     enable_compilation_cache()
     os.environ["TPUDL_FRAME_PREFETCH"] = "1" if arm == "prefetch" else "0"
     if arm == "prefetch":
@@ -953,6 +1015,7 @@ def measure_predictor(dtype):
 
 def measure_keras_transformer():
     """configs[4]: KerasTransformer over a tabular array column."""
+    _silence_tf_logs()
     import keras
 
     from tpudl.frame import Frame
@@ -987,6 +1050,7 @@ def measure_keras_transformer():
 def measure_estimator_fit():
     """configs[2]: KerasImageFileEstimator time-to-fit (transfer-learning
     loop: ingest keras model -> train over image files -> transformer)."""
+    _silence_tf_logs()
     import keras
     from PIL import Image
 
@@ -1037,6 +1101,7 @@ def measure_estimator_inception():
     KerasImageFileEstimator on ~100 synthetic 299×299 images — the
     sparkdl transfer-learning shape, timed. The tiny-CNN entry stays as
     the quick smoke; this is the judged config."""
+    _silence_tf_logs()
     import keras
     from PIL import Image
 
@@ -1474,7 +1539,7 @@ def measure_tf_cpu_baseline(k=64, batch=32, trials=3):
     median with every trial reported, so the record shows the baseline
     is measured live each run."""
     os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
-    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    _silence_tf_logs()
     import keras
 
     log("building TF-CPU InceptionV3 baseline ...")
@@ -1531,7 +1596,8 @@ def main():
         "batch_size": batch,
         "baseline": "keras InceptionV3 on TF-CPU (fp32), this host",
     }
-    _start_watchdog(extra)
+    _arm_flight_recorder()  # before the handlers below: SIGTERM path
+    _start_watchdog(extra)  # dumps via _install_sigterm_flush's handler
     _install_sigterm_flush(extra)
     log(f"bench budget: {_budget_s():.0f}s (TPUDL_BENCH_BUDGET_S)")
 

@@ -193,10 +193,13 @@ def test_quick_run_under_tight_budget_emits_summary_last(tmp_path):
 
 
 def test_sigterm_handler_flushes_partial_summary(bench, monkeypatch,
-                                                 capsys):
+                                                 capsys, tmp_path):
     """SIGTERM (the driver's kill) must flush whatever has been measured
-    as a valid last-line summary before exiting."""
+    as a valid last-line summary before exiting — AND leave a
+    schema-valid flight-recorder dump next to it (ISSUE 5: the rc=124
+    class must produce forensics, not just an stderr tail)."""
     monkeypatch.setenv("TPUDL_BENCH_RECORD_NAME", "contract_sigterm_test")
+    monkeypatch.setenv("TPUDL_FLIGHT_DIR", str(tmp_path))
     rec_path = os.path.join(REPO, "bench_records",
                             "contract_sigterm_test.json")
     bench._EMITTED.clear()
@@ -213,6 +216,15 @@ def test_sigterm_handler_flushes_partial_summary(bench, monkeypatch,
         assert s["partial"] is True and s["sigterm"] is True
         assert s["value"] is None
         assert exits == [0]
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("tpudl-dump-")]
+        assert len(dumps) == 1
+        spec = importlib.util.spec_from_file_location(
+            "validate_dump", os.path.join(REPO, "tools",
+                                          "validate_dump.py"))
+        vd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vd)
+        assert vd.validate_dump(str(tmp_path / dumps[0])) == []
     finally:
         import signal as _signal
 

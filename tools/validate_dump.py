@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Schema + ring-bound audit for tpudl flight-recorder dumps.
+
+The third member of the validator family (validate_metrics.py for the
+JSONL sink, validate_shards.py for the batch cache): a
+``tpudl-dump-*.json.gz`` written by :mod:`tpudl.obs.flight` must parse,
+carry every schema key with the right type, keep its rings inside
+their declared bounds (a dump bigger than its rings means the recorder
+leaked), and hold NO batch data — descriptors are shapes/dtypes/
+fingerprints only.
+
+Pure stdlib, importable (``from validate_dump import validate_dump``)
+and runnable (``python tools/validate_dump.py <dump-or-dir>``); wired
+into tier-1 by tests/test_obs_flight.py the same way the other two
+validators are.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+
+_NUM = (int, float)
+SCHEMA = "tpudl-flight-dump"
+VERSION = 1
+
+# key -> required python types of the top-level payload
+_TOP_KEYS = {
+    "schema": str,
+    "version": int,
+    "reason": str,
+    "ts": _NUM,
+    "pid": int,
+    "process_index": int,
+    "process_count": int,
+    "argv": list,
+    "python": str,
+    "backend": dict,
+    "env": dict,
+    "error": (dict, type(None)),
+    "batches": list,
+    "errors": list,
+    "stalls": list,
+    "metric_ticks": list,
+    "restarts": list,
+    "events": list,
+    "metrics": dict,
+    "pipeline_reports": dict,
+    "spans": list,
+    "heartbeats": dict,
+}
+# ring ceilings (generous: the env can raise the defaults, but a dump
+# orders of magnitude past these means an unbounded recorder)
+_RING_CAPS = {"batches": 4096, "errors": 4096, "stalls": 1024,
+              "metric_ticks": 4096, "restarts": 64, "events": 64,
+              "spans": 65536}
+_BATCH_KEYS = {"ts": _NUM, "stage": str, "index": int,
+               "shapes": list, "dtypes": list}
+_ERROR_KEYS = {"ts": _NUM, "kind": str, "message": str}
+_STALL_KEYS = {"ts": _NUM, "name": str, "age_s": _NUM, "stall_s": _NUM,
+               "stacks": dict}
+
+
+def _check_keys(obj: dict, spec: dict, where: str) -> list[str]:
+    errs = []
+    for key, types in spec.items():
+        if key not in obj:
+            errs.append(f"{where}: missing key {key!r}")
+        elif not isinstance(obj[key], types):
+            errs.append(f"{where}: {key}={type(obj[key]).__name__} "
+                        f"is not {types}")
+    return errs
+
+
+def validate_payload(payload) -> list[str]:
+    """Errors in one parsed dump payload (empty list = valid)."""
+    if not isinstance(payload, dict):
+        return ["payload is not a JSON object"]
+    errs = _check_keys(payload, _TOP_KEYS, "dump")
+    if payload.get("schema") not in (None, SCHEMA):
+        errs.append(f"dump: schema {payload['schema']!r} != {SCHEMA!r}")
+    if isinstance(payload.get("version"), int) \
+            and payload["version"] > VERSION:
+        errs.append(f"dump: version {payload['version']} is newer than "
+                    f"this validator ({VERSION})")
+    # ring bounds: a leaked (unbounded) recorder shows up here
+    for ring, cap in _RING_CAPS.items():
+        entries = payload.get(ring)
+        if isinstance(entries, list) and len(entries) > cap:
+            errs.append(f"dump: ring {ring!r} holds {len(entries)} "
+                        f"entries (bound audit cap {cap})")
+    for i, b in enumerate(payload.get("batches") or []):
+        if not isinstance(b, dict):
+            errs.append(f"batches[{i}]: not an object")
+            continue
+        errs.extend(_check_keys(b, _BATCH_KEYS, f"batches[{i}]"))
+        # the never-data contract: a descriptor is shapes/dtypes/
+        # fingerprint — any list-of-numbers payload key is a leak
+        for k, v in b.items():
+            if k in ("shapes",):
+                continue
+            if isinstance(v, list) and len(v) > 64:
+                errs.append(f"batches[{i}].{k}: {len(v)}-element list "
+                            "(descriptors must not carry data)")
+    for i, e in enumerate(payload.get("errors") or []):
+        if isinstance(e, dict):
+            errs.extend(_check_keys(e, _ERROR_KEYS, f"errors[{i}]"))
+        else:
+            errs.append(f"errors[{i}]: not an object")
+    for i, s in enumerate(payload.get("stalls") or []):
+        if isinstance(s, dict):
+            errs.extend(_check_keys(s, _STALL_KEYS, f"stalls[{i}]"))
+        else:
+            errs.append(f"stalls[{i}]: not an object")
+    # metrics reuse the sink's typed-dict schema when the validator is
+    # importable (a wheel install may not ship tools/)
+    try:
+        from validate_metrics import validate_metric_entry
+
+        for name, entry in (payload.get("metrics") or {}).items():
+            errs.extend(f"metrics: {e}"
+                        for e in validate_metric_entry(name, entry))
+    except ImportError:
+        pass
+    return errs
+
+
+def validate_dump(path: str) -> list[str]:
+    """Errors for one dump file (parse + schema + ring bounds)."""
+    try:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError, EOFError) as e:
+        return [f"{path}: unreadable ({e!r})"]
+    return [f"{path}: {e}" for e in validate_payload(payload)]
+
+
+def validate_path(path: str) -> tuple[list[str], int]:
+    """(errors, n_dumps) for a dump file or a directory of dumps."""
+    if os.path.isdir(path):
+        files = sorted(
+            glob.glob(os.path.join(path, "tpudl-dump-*.json.gz"))
+            + glob.glob(os.path.join(path, "tpudl-dump-*.json")))
+    else:
+        files = [path]
+    if not files:
+        return [f"{path}: no tpudl-dump-*.json[.gz] files"], 0
+    errs: list[str] = []
+    for f in files:
+        errs.extend(validate_dump(f))
+    return errs, len(files)
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: validate_dump.py <tpudl-dump-*.json.gz | dir>",
+              file=sys.stderr)
+        return 2
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    errors, n = validate_path(argv[1])
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    print(f"{argv[1]}: {n} dump(s), "
+          f"{'OK' if not errors else str(len(errors)) + ' errors'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

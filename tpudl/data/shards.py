@@ -254,6 +254,12 @@ class ShardCache:
         except (ShardCorruption, OSError, ValueError) as e:
             _m.counter("data.cache.corrupt").inc()
             _m.counter("data.cache.misses").inc()
+            # black box: the doctor's decode-error-storm rule needs the
+            # corruption SAMPLES, not just the count (obs/flight.py)
+            from tpudl.obs import flight as _flight
+
+            _flight.record_error("data.cache.corrupt", e,
+                                 index=int(index), key=self.key)
             self._drop(index, reason=repr(e))
             return None
         _m.counter("data.cache.hits").inc()

@@ -41,6 +41,16 @@ def initialize(coordinator_address: str | None = None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id, **kwargs)
+    # black-box breadcrumb: after the join, this process's flight dumps
+    # are keyed by its process_index (tpudl-dump-host<idx>-<pid>), and
+    # the doctor merges every host's file from one shared dir
+    from tpudl.obs import flight as _flight
+
+    _flight.get_recorder().record_event(
+        "distributed.initialize",
+        coordinator=str(coordinator_address),
+        process_index=jax.process_index(),
+        process_count=jax.process_count())
 
 
 def process_count() -> int:

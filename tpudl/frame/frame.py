@@ -564,6 +564,7 @@ class Frame:
             raise KeyError(f"unknown input columns {missing}")
 
         from tpudl import obs  # deferred: host-only frames stay light
+        from tpudl.obs import flight as _flight
 
         report = obs.PipelineReport()
 
@@ -652,6 +653,7 @@ class Frame:
             with report.stage("prepare"):
                 bidx = start // batch_size
                 packed = None
+                cache_hit = False
                 if cache is not None:
                     hit = cache.get(bidx)
                     # an all-hits replay still needs resolved codecs for
@@ -667,6 +669,7 @@ class Frame:
                         # must be writable copies or cold/warm diverge
                         packed = (list(hit) if device_flag
                                   else [np.array(a) for a in hit])
+                        cache_hit = True
                 if packed is None:
                     if cache is not None:
                         report.count("cache_misses")
@@ -694,6 +697,13 @@ class Frame:
                             cache.set_meta({"codecs": plan.keys()})
                 if plan is not None:
                     plan.record_shipped(packed)
+                # black-box descriptor: shapes/dtypes/fingerprint only
+                # (never data) — a dump shows what the last batches
+                # looked like (tpudl.obs.flight)
+                _flight.record_batch("prepare", bidx, packed,
+                                     rows=stop - start,
+                                     cache_hit=cache_hit,
+                                     run=report.run_id)
                 n_pad = 0
                 if mesh is not None:
                     # every column slices the same rows, so one pad count
@@ -767,6 +777,15 @@ class Frame:
         # only the leading run of full-size batches is fusable (the
         # ragged tail would change the compiled (m, B, ...) signature)
         n_full = sum(1 for s, e in spans if e - s == batch_size)
+        # watchdog supervision: ONE heartbeat for the whole run, beaten
+        # at every stage entry (PipelineReport.stage) — a freeze inside
+        # prepare/h2d/dispatch/d2h surfaces as a stall NAMING that
+        # stage. Registered before the infeed so the prepare pool's
+        # first batches are already supervised; deregistered on every
+        # exit path below (finished work cannot false-flag).
+        hb_run = obs.heartbeat("frame.map_batches", run=report.run_id,
+                               rows=self._n)
+        report.heartbeat = hb_run
         infeed = (_PipelineInfeed(prepare, spans, depth, workers, report)
                   if prefetch else None)
         consumed = 0
@@ -792,41 +811,48 @@ class Frame:
 
         t_wall = time.perf_counter()
         try:
-            while consumed < len(spans):
-                if fuse > 1 and consumed + fuse <= n_full:
-                    group = [next_prepared() for _ in range(fuse)]
-                    try:
-                        stacked = [np.stack([g[0][j] for g in group])
-                                   for j in range(len(input_cols))]
-                    except ValueError:
-                        # shapes drifted between microbatches (variable-
-                        # geometry pack): dispatch this group per-batch
-                        for packed, n_pad in group:
-                            with report.stage("dispatch"):
-                                result = _run_fn()(*packed)
-                            handle(result, n_pad)
-                        continue
-                    fused_fn = _fused_wrapper(_run_fn(), fuse)
-                    with report.stage("dispatch"):
-                        result = fused_fn(*stacked)
-                    report.count("fused_dispatches")
-                    handle(result, 0)
-                else:
-                    packed, n_pad = next_prepared()
-                    with report.stage("dispatch"):
-                        result = _run_fn()(*packed)
-                    handle(result, n_pad)
+            try:
+                while consumed < len(spans):
+                    if fuse > 1 and consumed + fuse <= n_full:
+                        group = [next_prepared() for _ in range(fuse)]
+                        try:
+                            stacked = [np.stack([g[0][j] for g in group])
+                                       for j in range(len(input_cols))]
+                        except ValueError:
+                            # shapes drifted between microbatches
+                            # (variable-geometry pack): dispatch this
+                            # group per-batch
+                            for packed, n_pad in group:
+                                with report.stage("dispatch"):
+                                    result = _run_fn()(*packed)
+                                handle(result, n_pad)
+                            continue
+                        fused_fn = _fused_wrapper(_run_fn(), fuse)
+                        with report.stage("dispatch"):
+                            result = fused_fn(*stacked)
+                        report.count("fused_dispatches")
+                        handle(result, 0)
+                    else:
+                        packed, n_pad = next_prepared()
+                        with report.stage("dispatch"):
+                            result = _run_fn()(*packed)
+                        handle(result, n_pad)
+            finally:
+                if infeed is not None:
+                    infeed.close()
+                if cache is not None:
+                    cache.flush()  # persist throttled manifest entries
+            while pending:
+                with report.stage("d2h"):
+                    _drain(pending.pop(0), outputs)
+            if mode == "acc":
+                with report.stage("d2h"):
+                    _fetch_accumulated(acc, segs, outputs)
         finally:
-            if infeed is not None:
-                infeed.close()
-            if cache is not None:
-                cache.flush()  # persist any throttled manifest entries
-        while pending:
-            with report.stage("d2h"):
-                _drain(pending.pop(0), outputs)
-        if mode == "acc":
-            with report.stage("d2h"):
-                _fetch_accumulated(acc, segs, outputs)
+            # the final d2h drain runs supervised too (a wedged fetch
+            # IS the interesting stall); only now does the run's
+            # heartbeat leave the watchdog's scan list
+            hb_run.__exit__(None, None, None)
         # close out the run: wall time + publish totals into the
         # process-wide metrics registry (obs.snapshot() / JSONL sink)
         if plan is not None and plan.resolved():

@@ -688,11 +688,21 @@ def _decode_row(decode_f, origin, raw):
     ndarray results are wrapped into structs with the file origin."""
     try:
         out = decode_f(raw)
-    except Exception:
+    except Exception as e:
         _obs_metrics.counter("imageio.decode_errors").inc()
+        # a SAMPLE lands in the flight recorder's error ring (bounded),
+        # so a post-mortem shows WHICH files went bad, not just how many
+        # (the doctor's decode-error-storm rule, obs/doctor.py)
+        from tpudl.obs import flight as _flight
+
+        _flight.record_error("imageio.decode_error", e, origin=origin)
         return None
     if out is None:
         _obs_metrics.counter("imageio.decode_errors").inc()
+        from tpudl.obs import flight as _flight
+
+        _flight.record_error("imageio.decode_error",
+                             "decode_f returned None", origin=origin)
         return None
     if isinstance(out, dict):
         out = dict(out)

@@ -36,6 +36,7 @@ from tpudl import mesh as M
 from tpudl.ml.image_params import CanLoadImage
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
+from tpudl.obs import watchdog as _obs_watchdog
 from tpudl.ml.keras_image import KerasImageFileTransformer
 from tpudl.ml.losses import get_loss, get_optimizer_dynamic
 from tpudl.ml.params import (HasInputCol, HasKerasLoss, HasKerasModel,
@@ -254,12 +255,17 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         target = math.ceil(batch_size / width) * width
         losses = []
         n_steps = 0
-        with _obs_tracer.span("estimator.train_trial", epochs=epochs,
-                              batch_size=target, slice_width=width):
+        with _obs_watchdog.heartbeat("estimator.train_trial",
+                                     epochs=epochs) as hb, \
+                _obs_tracer.span("estimator.train_trial", epochs=epochs,
+                                 batch_size=target, slice_width=width):
             for _epoch in range(epochs):
                 order = rng.permutation(n) if shuffle else np.arange(n)
                 batch_losses = []  # device-resident; ONE fetch per epoch
                 for start in range(0, n, target):
+                    # one beat per train step: a hung step flags a
+                    # stall naming the epoch/step it froze at
+                    hb.beat(epoch=_epoch, step=n_steps)
                     idx = order[start:start + target]
                     if len(idx) < target:
                         reps = math.ceil((target - len(idx)) / n)

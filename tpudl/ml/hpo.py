@@ -30,6 +30,7 @@ import jax
 
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
+from tpudl.obs import watchdog as _obs_watchdog
 
 __all__ = ["TrialScheduler", "device_slices"]
 
@@ -89,13 +90,21 @@ class TrialScheduler:
             _obs_metrics.counter("hpo.trials_started").inc()
             t0 = time.perf_counter()
             try:
-                with _obs_tracer.span("hpo.trial", index=i,
-                                      slice_width=len(slices[s])):
+                # watchdog supervision: a trial that wedges (stuck
+                # compile, hung RPC) flags a stall naming its index;
+                # the inner train/map_batches heartbeats keep beating
+                # underneath it while healthy
+                with _obs_watchdog.heartbeat("hpo.trial", index=i), \
+                        _obs_tracer.span("hpo.trial", index=i,
+                                         slice_width=len(slices[s])):
                     out = i, trial_fn(i, item, slices[s])
                 _obs_metrics.counter("hpo.trials_completed").inc()
                 return out
-            except BaseException:
+            except BaseException as e:
                 _obs_metrics.counter("hpo.trials_failed").inc()
+                from tpudl.obs import flight as _obs_flight
+
+                _obs_flight.record_error("hpo.trial_failed", e, index=i)
                 raise
             finally:
                 _obs_metrics.histogram("hpo.trial_seconds").observe(

@@ -17,14 +17,27 @@ guide), three pillars:
   summary (device busy %, host stage totals, overlap).
 
 Per-run executor reports (:class:`PipelineReport`) live in
-:mod:`tpudl.obs.pipeline`, kept in a bounded ring keyed by run id;
-``last_pipeline_report()`` stays the newest entry.
+:mod:`tpudl.obs.pipeline`, kept in a bounded ring keyed by run id.
+
+The black-box layer (OBSERVABILITY.md "Failure forensics"):
+
+- :mod:`tpudl.obs.flight` — always-on bounded flight recorder;
+  ``obs.dump()`` (or an unhandled exception / SIGTERM / SIGQUIT after
+  ``obs.flight.install()``) writes a self-contained
+  ``tpudl-dump-<pid>.json.gz``;
+- :mod:`tpudl.obs.watchdog` — heartbeat registry + stall daemon
+  (``TPUDL_WATCHDOG_STALL_S``); stalls snapshot every thread's stack
+  into the recorder and bump ``obs.watchdog.stalls``;
+- :mod:`tpudl.obs.doctor` — ``python -m tpudl.obs doctor <dump|dir>``
+  merges per-host dumps and classifies the failure.
 """
 
 from __future__ import annotations
 
+from tpudl.obs.flight import dump, get_recorder, record_error
 from tpudl.obs.metrics import (Meter, counter, flush_metrics, gauge,
                                get_registry, histogram, snapshot, timed)
+from tpudl.obs.watchdog import heartbeat, start_watchdog
 from tpudl.obs.pipeline import (PipelineReport, get_pipeline_report,
                                 last_pipeline_report, pipeline_reports,
                                 set_last_pipeline)
@@ -46,4 +59,7 @@ __all__ = [
     # per-run pipeline reports
     "PipelineReport", "last_pipeline_report", "set_last_pipeline",
     "pipeline_reports", "get_pipeline_report",
+    # failure forensics (flight recorder + watchdog)
+    "dump", "get_recorder", "record_error", "heartbeat",
+    "start_watchdog",
 ]

@@ -12,6 +12,12 @@ a CPU-only run gets host totals, a host-blind capture gets device lanes.
 ``metrics <file.jsonl>`` schema-checks and tail-summarizes a
 ``TPUDL_METRICS_FILE`` emission (delegates the check to
 ``tools/validate_metrics.py``'s rules).
+
+``doctor <dump-or-dir>`` merges flight-recorder dumps
+(``tpudl-dump-*.json.gz``, one per process) and classifies the failure
+— infeed stall vs decode-error storm vs dispatch slowdown vs clean
+external kill — printing the timeline tail, per-stage throughput at
+time of death, and the suspect stage (:mod:`tpudl.obs.doctor`).
 """
 
 from __future__ import annotations
@@ -108,10 +114,25 @@ def cmd_metrics(path: str) -> int:
     return 0 if not errors else 1
 
 
+def cmd_doctor(path: str, tail: int = 12) -> int:
+    from tpudl.obs import doctor as D
+
+    got = D.diagnose(path)
+    if got is None:
+        print(f"no flight-recorder dumps (tpudl-dump-*.json[.gz]) "
+              f"under {path}", file=sys.stderr)
+        return 2
+    merged, diagnosis = got
+    print(D.format_report(merged, diagnosis, tail=tail))
+    # rc contract: 0 = readable + classified, 1 = unclassified (a human
+    # must look), 2 = no dumps at all
+    return 0 if diagnosis["classification"] != "unclassified" else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tpudl.obs",
-        description="merge + summarize tpudl traces and metrics")
+        description="merge + summarize tpudl traces, metrics and dumps")
     sub = p.add_subparsers(dest="cmd", required=True)
     pt = sub.add_parser("trace", help="merge host + device traces in a dir")
     pt.add_argument("trace_dir")
@@ -119,9 +140,16 @@ def main(argv=None) -> int:
                     help="merged trace path (default <dir>/merged.trace.json)")
     pm = sub.add_parser("metrics", help="validate + summarize a metrics JSONL")
     pm.add_argument("path")
+    pd = sub.add_parser(
+        "doctor", help="classify a failure from flight-recorder dump(s)")
+    pd.add_argument("path", help="one tpudl-dump-*.json.gz or a dir of them")
+    pd.add_argument("--tail", type=int, default=12,
+                    help="timeline tail length (default 12 spans)")
     args = p.parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args.trace_dir, args.out)
+    if args.cmd == "doctor":
+        return cmd_doctor(args.path, args.tail)
     return cmd_metrics(args.path)
 
 

@@ -1,0 +1,368 @@
+"""``obs doctor`` — turn flight-recorder dumps into a diagnosis.
+
+The offline half of failure forensics (OBSERVABILITY.md): given one
+``tpudl-dump-*.json.gz`` (or a directory of them from a multi-host
+gang), merge per-host evidence and CLASSIFY the failure:
+
+- ``infeed_stall`` — the watchdog flagged a frozen input-side stage
+  (prepare/h2d/infeed), or the pipeline report died with the consumer
+  parked in ``infeed_wait``: the input pipeline stopped delivering;
+- ``decode_error_storm`` — decode/corruption errors are a large
+  fraction of reads (``imageio.decode_errors``, ``data.cache.corrupt``
+  and the error ring agree): the data went bad, not the code;
+- ``dispatch_slowdown`` — a stall (or dominant stage share) in
+  ``dispatch``: the device/backend stopped answering or slowed;
+- ``clean_external_kill`` — a SIGTERM/SIGQUIT dump with no stall and
+  no error storm: the driver killed a healthy run (the rc=124 class);
+- ``exception`` — an unhandled exception dump: the error is right
+  there;
+- ``unclassified`` — evidence exists but matches no rule (everything
+  the doctor looked at is printed, so a human can take over).
+
+Importable (:func:`load_dumps` / :func:`merge_dumps` / :func:`classify`
+/ :func:`format_report`) and runnable:
+``python -m tpudl.obs doctor <dump-or-dir>``.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+
+__all__ = ["load_dump", "load_dumps", "merge_dumps", "classify",
+           "format_report", "INFEED_STAGES"]
+
+# input-side stage names: a stall whose last beat named one of these is
+# the input pipeline's fault, not the device's
+INFEED_STAGES = ("prepare", "h2d", "infeed", "infeed_wait", "decode",
+                 "pack", "cache")
+# storm thresholds: at least this many bad events AND this fraction of
+# the read attempts (an isolated corrupt file is noise, not a storm)
+STORM_MIN_EVENTS = 8
+STORM_MIN_FRAC = 0.10
+
+
+def load_dump(path: str) -> dict:
+    """One dump file (gzip or plain JSON) → payload dict."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def find_dump_files(path: str) -> list[str]:
+    """Dump files under ``path`` (a file is itself; a directory is
+    scanned for the recorder's naming pattern, both gzip and plain)."""
+    if os.path.isdir(path):
+        hits = sorted(glob.glob(os.path.join(path, "tpudl-dump-*.json.gz"))
+                      + glob.glob(os.path.join(path, "tpudl-dump-*.json")))
+        return hits
+    return [path] if os.path.exists(path) else []
+
+
+def load_dumps(path: str) -> list[dict]:
+    """Every parseable dump under ``path``; unreadable files are
+    skipped (a torn dump from a dying host must not block the
+    readable ones)."""
+    dumps = []
+    for p in find_dump_files(path):
+        try:
+            d = load_dump(p)
+        except (OSError, json.JSONDecodeError, EOFError):
+            continue
+        if isinstance(d, dict) and d.get("schema") == "tpudl-flight-dump":
+            d["_path"] = p
+            dumps.append(d)
+    return dumps
+
+
+def merge_dumps(dumps: list[dict]) -> dict:
+    """Per-process dumps → one merged view. Deduplication is keyed by
+    (process_index, pid) — only dumps from the SAME process (an
+    explicit obs.dump() plus the death dump) collapse to the newest;
+    two processes sharing index 0 (a bench parent and its trial
+    subprocess in one dir) both keep their evidence. ``hosts`` keys
+    are the process index, suffixed with the pid only when several
+    processes share an index. The merged timeline tail interleaves
+    every process's spans by wall-clock."""
+    by_proc: dict[tuple[int, int], dict] = {}
+    for d in dumps:
+        key = (int(d.get("process_index", 0) or 0),
+               int(d.get("pid", 0) or 0))
+        cur = by_proc.get(key)
+        if cur is None or d.get("ts", 0) >= cur.get("ts", 0):
+            by_proc[key] = d
+    idx_counts: dict[int, int] = {}
+    for idx, _pid in by_proc:
+        idx_counts[idx] = idx_counts.get(idx, 0) + 1
+    hosts: dict[str, dict] = {}
+    items = []  # (host label for attribution, dump)
+    for (idx, pid), d in sorted(by_proc.items()):
+        label = str(idx) if idx_counts[idx] == 1 else f"{idx}:{pid}"
+        hosts[label] = d
+        items.append((label, d))
+    spans = []
+    for label, d in items:
+        for s in d.get("spans", []) or []:
+            spans.append(dict(s, host=label))
+    spans.sort(key=lambda s: s.get("ts_us") or 0)
+
+    def _by_ts(key):
+        # wall-clock order across processes: "the last stall" must be
+        # the NEWEST event, not whichever dump iterated last
+        entries = [dict(e, host=label) for label, d in items
+                   for e in d.get(key, []) or []]
+        entries.sort(key=lambda e: e.get("ts") or 0)
+        return entries
+
+    return {"hosts": hosts, "n_hosts": len(hosts),
+            "spans": spans,
+            "stalls": _by_ts("stalls"),
+            "errors": _by_ts("errors"),
+            "restarts": _by_ts("restarts")}
+
+
+def _metric_value(dump: dict, name: str) -> float:
+    m = (dump.get("metrics") or {}).get(name) or {}
+    v = m.get("value")
+    return float(v) if isinstance(v, (int, float)) else 0.0
+
+
+def _last_report(dump: dict) -> dict | None:
+    reports = dump.get("pipeline_reports") or {}
+    if not reports:
+        return None
+    # ring order is oldest→newest; run ids are "<pid>-<seq>"
+    return list(reports.values())[-1]
+
+
+def _stage_rates(report: dict | None) -> dict:
+    """Per-stage throughput at time of death: seconds, calls and
+    seconds-per-call for each executor stage of the newest report."""
+    if not report:
+        return {}
+    secs = report.get("stage_seconds") or {}
+    calls = report.get("stage_calls") or {}
+    out = {}
+    for name, s in secs.items():
+        n = calls.get(name) or 0
+        out[name] = {"seconds": round(float(s), 4), "calls": int(n),
+                     "s_per_call": round(float(s) / n, 5) if n else None}
+    return out
+
+
+def _stall_stage(stall: dict) -> str | None:
+    # the frozen stage is the one ENTERED longest ago and never exited
+    # (in_flight), not the last one to beat: a wedged dispatch outlives
+    # the prepare pool's final beats
+    inflight = stall.get("in_flight") or {}
+    if inflight:
+        return max(inflight.items(),
+                   key=lambda kv: kv[1].get("age_s") or 0)[0]
+    info = stall.get("info") or {}
+    stage = info.get("stage")
+    return str(stage) if stage is not None else None
+
+
+def _is_infeed(stall: dict) -> bool:
+    stage = (_stall_stage(stall) or "").lower()
+    if any(k in stage for k in INFEED_STAGES):
+        return True
+    name = str(stall.get("name", "")).lower()
+    # a stalled frame heartbeat with no stage info yet: the run froze
+    # before its first dispatch — the input side by construction
+    return stage == "" and "frame" in name
+
+
+def classify(merged: dict) -> dict:
+    """The diagnosis: ``{classification, suspect_stage, suspect_host,
+    evidence: [...], stage_rates}``. Rules are ordered by specificity —
+    an error storm explains a stall (workers burning time on garbage),
+    so the storm wins when both fire."""
+    evidence: list[str] = []
+    hosts = merged.get("hosts") or {}
+    stalls = merged.get("stalls") or []
+    errors = merged.get("errors") or []
+    restarts = merged.get("restarts") or []
+
+    # evidence common to every rule
+    decode_errs = sum(_metric_value(d, "imageio.decode_errors")
+                      for d in hosts.values())
+    corrupt = sum(_metric_value(d, "data.cache.corrupt")
+                  for d in hosts.values())
+    reads = sum(_metric_value(d, "imageio.files_read")
+                + _metric_value(d, "data.cache.hits")
+                + _metric_value(d, "data.cache.misses")
+                for d in hosts.values())
+    bad = decode_errs + corrupt
+    ring_bad = sum(1 for e in errors
+                   if str(e.get("kind", "")).startswith(
+                       ("imageio", "decode", "data.cache", "shard")))
+    newest = max(hosts.values(), key=lambda d: d.get("ts", 0)) \
+        if hosts else {}
+    reason = str(newest.get("reason", ""))
+    report = _last_report(newest)
+    rates = _stage_rates(report)
+    suspect_host = None
+    if stalls:
+        suspect_host = stalls[-1].get("host")
+    if restarts:
+        evidence.append(
+            f"{len(restarts)} gang restart(s); last: "
+            f"{restarts[-1].get('error_type')}: "
+            f"{str(restarts[-1].get('error'))[:120]} "
+            f"(attempt {restarts[-1].get('attempt')}, "
+            f"step {restarts[-1].get('step')})")
+
+    # 1. decode-error storm: the strongest signal — bad data starves or
+    #    stalls everything downstream of it
+    if bad >= STORM_MIN_EVENTS and bad >= STORM_MIN_FRAC * max(reads, 1.0):
+        evidence.insert(0, (
+            f"{decode_errs:.0f} decode errors + {corrupt:.0f} corrupt "
+            f"shards over {reads:.0f} read attempts "
+            f"({bad / max(reads, 1.0):.0%}); {ring_bad} sample(s) in "
+            "the error ring"))
+        return {"classification": "decode_error_storm",
+                "suspect_stage": "decode",
+                "suspect_host": suspect_host,
+                "evidence": evidence, "stage_rates": rates}
+
+    # 2/3. watchdog stalls: which side froze?
+    if stalls:
+        last = stalls[-1]
+        stage = _stall_stage(last)
+        evidence.insert(0, (
+            f"watchdog flagged {len(stalls)} stall(s); last: "
+            f"{last.get('name')} frozen {last.get('age_s')}s in stage "
+            f"{stage or 'unknown'!r} on host {last.get('host')}"))
+        if _is_infeed(last):
+            return {"classification": "infeed_stall",
+                    "suspect_stage": stage or "prepare",
+                    "suspect_host": last.get("host"),
+                    "evidence": evidence, "stage_rates": rates}
+        if stage is not None:
+            return {"classification": "dispatch_slowdown",
+                    "suspect_stage": stage,
+                    "suspect_host": last.get("host"),
+                    "evidence": evidence, "stage_rates": rates}
+        # a supervised non-executor unit (train step, UDF call, HPO
+        # trial) froze with no stage attribution: an honest "stall"
+        # beats guessing a side — the dump's thread stacks say where
+        evidence.append("no stage attribution (non-executor "
+                        "heartbeat); see the stall's thread stacks "
+                        "in the dump")
+        return {"classification": "stall",
+                "suspect_stage": None,
+                "suspect_host": last.get("host"),
+                "evidence": evidence, "stage_rates": rates}
+
+    # 4. no stall, no storm, external signal: a healthy run was killed
+    if reason.startswith("signal"):
+        evidence.insert(0, (
+            f"dump reason {reason!r} with no stalls and no error "
+            "storm — the process was killed from outside while making "
+            "progress"))
+        if rates:
+            dominant = max(rates.items(),
+                           key=lambda kv: kv[1]["seconds"])
+            total = sum(v["seconds"] for v in rates.values()) or 1.0
+            evidence.append(
+                f"time went to {dominant[0]!r} "
+                f"({dominant[1]['seconds'] / total:.0%} of stage "
+                "time) — slow, not stuck")
+        return {"classification": "clean_external_kill",
+                "suspect_stage": None, "suspect_host": None,
+                "evidence": evidence, "stage_rates": rates}
+
+    # 5. unhandled exception: the error explains itself
+    err = newest.get("error")
+    if reason == "exception" and err:
+        evidence.insert(0, f"unhandled {err.get('type')}: "
+                        f"{str(err.get('message'))[:200]}")
+        return {"classification": "exception",
+                "suspect_stage": None, "suspect_host": None,
+                "evidence": evidence, "stage_rates": rates}
+
+    # 6. a slow-but-alive dispatch dominating the last report
+    if rates:
+        dominant = max(rates.items(), key=lambda kv: kv[1]["seconds"])
+        total = sum(v["seconds"] for v in rates.values()) or 1.0
+        share = dominant[1]["seconds"] / total
+        if dominant[0] == "dispatch" and share > 0.8:
+            evidence.insert(0, (
+                f"dispatch holds {share:.0%} of stage time "
+                f"({dominant[1]['s_per_call']}s/call) in the last "
+                "report — device-bound at death"))
+            return {"classification": "dispatch_slowdown",
+                    "suspect_stage": "dispatch", "suspect_host": None,
+                    "evidence": evidence, "stage_rates": rates}
+
+    evidence.insert(0, f"reason {reason!r}; no rule matched")
+    return {"classification": "unclassified", "suspect_stage": None,
+            "suspect_host": None, "evidence": evidence,
+            "stage_rates": rates}
+
+
+def format_report(merged: dict, diagnosis: dict,
+                  tail: int = 12) -> str:
+    """Human-readable doctor output: verdict first, then the evidence,
+    per-stage throughput at death, and the merged timeline tail."""
+    lines = []
+    hosts = merged.get("hosts") or {}
+    lines.append(f"== tpudl obs doctor — {len(hosts)} host dump(s) ==")
+    for idx in sorted(hosts, key=lambda k: [int(x)
+                                            for x in str(k).split(":")]):
+        d = hosts[idx]
+        lines.append(
+            f"  host {idx}: pid {d.get('pid')} reason "
+            f"{d.get('reason')!r} ({d.get('_path', '?')})")
+    lines.append("")
+    lines.append(f"DIAGNOSIS: {diagnosis['classification']}"
+                 + (f"  (suspect stage: {diagnosis['suspect_stage']}"
+                    + (f", host {diagnosis['suspect_host']}"
+                       if diagnosis.get("suspect_host") is not None
+                       else "") + ")"
+                    if diagnosis.get("suspect_stage") else ""))
+    for ev in diagnosis.get("evidence", []):
+        lines.append(f"  - {ev}")
+    rates = diagnosis.get("stage_rates") or {}
+    if rates:
+        lines.append("")
+        lines.append("per-stage throughput at time of death:")
+        for name, r in sorted(rates.items(),
+                              key=lambda kv: -kv[1]["seconds"]):
+            per = (f"{r['s_per_call'] * 1e3:.2f} ms/call"
+                   if r["s_per_call"] is not None else "-")
+            lines.append(f"  {name:<14} {r['seconds']:>9.3f}s "
+                         f"x{r['calls']:<6} {per}")
+    spans = merged.get("spans") or []
+    if spans:
+        lines.append("")
+        lines.append(f"timeline tail (last {min(tail, len(spans))} "
+                     "spans):")
+        for s in spans[-tail:]:
+            dur_ms = (s.get("dur_us") or 0) / 1e3
+            lines.append(f"  [host {s.get('host', 0)}] "
+                         f"{s.get('name', '?'):<28} {dur_ms:>10.2f} ms"
+                         + (f"  {s['attrs']}" if s.get("attrs") else ""))
+    errors = merged.get("errors") or []
+    if errors:
+        lines.append("")
+        lines.append(f"error ring tail ({min(5, len(errors))} of "
+                     f"{len(errors)}):")
+        for e in errors[-5:]:
+            lines.append(f"  [host {e.get('host', 0)}] "
+                         f"{e.get('kind')}: {e.get('type')} "
+                         f"{str(e.get('message'))[:100]}")
+    return "\n".join(lines)
+
+
+def diagnose(path: str) -> tuple[dict, dict] | None:
+    """Convenience: load + merge + classify ``path``; None when no
+    dumps are found."""
+    dumps = load_dumps(path)
+    if not dumps:
+        return None
+    merged = merge_dumps(dumps)
+    return merged, classify(merged)

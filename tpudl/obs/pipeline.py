@@ -76,16 +76,29 @@ class PipelineReport:
         self.gauges: dict[str, _metrics.Histogram] = {}
         self.wall_seconds = 0.0
         self.config: dict = {}
+        # the executor's watchdog heartbeat (set by map_batches): every
+        # stage ENTRY beats it with the stage name, so a freeze inside
+        # any stage leaves "last progress = entering <stage>" as the
+        # stall's suspect (tpudl.obs.watchdog)
+        self.heartbeat = None
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str):
+        # enter/exit (not a bare beat): the stage stays IN FLIGHT on
+        # the heartbeat until it returns, so a freeze inside dispatch
+        # is still the suspect after prepare workers beat afterwards
+        hb = self.heartbeat
+        if hb is not None:
+            hb.stage_enter(name)
         with _tracer.span(f"frame.{name}", run=self.run_id):
             t0 = time.perf_counter()
             try:
                 yield
             finally:
                 self.add(name, time.perf_counter() - t0)
+                if hb is not None:
+                    hb.stage_exit(name)
 
     def add(self, name: str, seconds: float):
         with self._lock:

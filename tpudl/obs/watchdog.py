@@ -1,0 +1,342 @@
+"""Stall watchdog: heartbeat registry + the no-progress daemon.
+
+A hung infeed and a slow run look identical from outside — both just
+stop printing. The watchdog makes the difference observable WHILE the
+process is still alive (OBSERVABILITY.md "Failure forensics"):
+
+- instrumented layers hold a :class:`Heartbeat` while they own work and
+  ``beat()`` on every unit of progress — the frame executor beats per
+  stage event (prepare/h2d/dispatch/d2h), ``Trainer.fit`` per step, UDF
+  calls and HPO trials per invocation;
+- a daemon thread (:class:`Watchdog`) scans the active heartbeats every
+  ``interval`` seconds; one that hasn't beaten for
+  ``TPUDL_WATCHDOG_STALL_S`` seconds is flagged as STALLED: the event —
+  name, last-beat info (which stage froze), age, and a snapshot of
+  EVERY Python thread's stack (``sys._current_frames``) — lands in the
+  flight recorder's stall ring, ``obs.watchdog.stalls`` is bumped, and
+  a warning is logged. One flag per stall episode (re-armed by the next
+  beat), so a 10-minute hang is one event, not 600.
+
+The daemon starts lazily on the first ``heartbeat(...)`` when
+``TPUDL_WATCHDOG_STALL_S`` is set (> 0), or explicitly via
+:func:`start_watchdog`. Beating is a lock + two attribute writes — the
+executor overhead guard (tests/test_obs_flight.py) covers it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+__all__ = ["Heartbeat", "HeartbeatRegistry", "Watchdog", "get_registry",
+           "heartbeat", "start_watchdog", "stop_watchdog",
+           "thread_stacks"]
+
+log = logging.getLogger("tpudl.obs.watchdog")
+
+DEFAULT_STALL_S = 30.0
+
+
+def _env_stall_s() -> float:
+    try:
+        return float(os.environ.get("TPUDL_WATCHDOG_STALL_S", "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def thread_stacks(limit: int = 40) -> dict[str, list[str]]:
+    """Every live Python thread's current stack, formatted — the "where
+    is everyone frozen" snapshot a stall event carries. Keys are
+    ``"<tid>:<thread name>"``."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        stack = traceback.format_stack(frame, limit=limit)
+        out[f"{tid}:{names.get(tid, '?')}"] = [ln.rstrip()
+                                               for ln in stack]
+    return out
+
+
+class Heartbeat:
+    """One unit of supervised work. Use as a context manager::
+
+        with watchdog.heartbeat("train.fit", steps=100) as hb:
+            for step in ...:
+                hb.beat(step=step)
+
+    While the block is active and not beating, the daemon counts its
+    age; leaving the block deregisters it (finished work can't stall).
+
+    Two refinements matter for honest attribution:
+
+    - **parent re-arm** — a heartbeat created while another is active
+      on the same thread records it as its parent, and every beat
+      re-arms the whole parent chain. An outer coarse heartbeat (a UDF
+      call, an HPO trial) with one beat per invocation therefore never
+      false-flags while its inner executor/trainer heartbeats are
+      making progress — it only stalls when EVERYTHING under it does;
+    - **in-flight stages** — ``stage_enter``/``stage_exit`` (used by
+      ``PipelineReport.stage``) track which stages are currently
+      ENTERED and for how long. A stall's suspect is the oldest
+      in-flight stage, not the most recent beat: a wedged dispatch
+      stays in flight while the prepare pool's final beats come and
+      go, so it cannot be mis-blamed on the input side.
+    """
+
+    __slots__ = ("name", "info", "started", "last_beat", "beats",
+                 "stalled", "parent", "_registry", "_inflight",
+                 "_iflock")
+
+    def __init__(self, name: str, registry: "HeartbeatRegistry",
+                 parent: "Heartbeat | None" = None, **info):
+        self.name = str(name)
+        self.info = dict(info)
+        self.started = time.monotonic()
+        self.last_beat = self.started
+        self.beats = 0
+        self.stalled = False
+        self.parent = parent
+        self._registry = registry
+        self._inflight: dict[str, list] = {}  # stage -> [count, t0]
+        self._iflock = threading.Lock()
+
+    def beat(self, **info):
+        """Progress happened. ``info`` overlays the heartbeat's info
+        (e.g. ``stage="prepare"``) so a later stall names the exact
+        stage that beat LAST; the parent chain is re-armed too."""
+        now = time.monotonic()
+        self.last_beat = now
+        self.beats += 1
+        self.stalled = False  # re-arm: one event per stall episode
+        if info:
+            self.info.update(info)
+        p = self.parent
+        while p is not None:  # child progress IS parent progress
+            p.last_beat = now
+            p.stalled = False
+            p = p.parent
+
+    def stage_enter(self, stage: str):
+        """A named stage began (and beat): it stays IN FLIGHT until
+        ``stage_exit``, so a freeze inside it is attributable even
+        after other stages beat afterwards."""
+        self.beat(stage=stage)
+        with self._iflock:
+            ent = self._inflight.setdefault(stage, [0, 0.0])
+            if ent[0] == 0:
+                ent[1] = time.monotonic()
+            ent[0] += 1
+
+    def stage_exit(self, stage: str):
+        self.beat()
+        with self._iflock:
+            ent = self._inflight.get(stage)
+            if ent is not None:
+                ent[0] -= 1
+                if ent[0] <= 0:
+                    self._inflight.pop(stage, None)
+
+    def inflight(self, now: float | None = None) -> dict:
+        """``{stage: {count, age_s}}`` of currently-entered stages —
+        the stall event's suspect material."""
+        now = now if now is not None else time.monotonic()
+        with self._iflock:
+            return {k: {"count": v[0], "age_s": round(now - v[1], 3)}
+                    for k, v in self._inflight.items()}
+
+    def age(self, now: float | None = None) -> float:
+        return (now if now is not None else time.monotonic()) \
+            - self.last_beat
+
+    def describe(self, now: float | None = None) -> dict:
+        return {"name": self.name, "info": dict(self.info),
+                "beats": self.beats, "age_s": round(self.age(now), 3),
+                "alive_s": round(
+                    (now if now is not None else time.monotonic())
+                    - self.started, 3),
+                "in_flight": self.inflight(now),
+                "stalled": self.stalled}
+
+    def __enter__(self) -> "Heartbeat":
+        return self
+
+    def __exit__(self, *exc):
+        self._registry._remove(self)
+        return False
+
+
+class HeartbeatRegistry:
+    """Thread-safe set of active heartbeats (the watchdog's scan
+    list). A per-thread stack links nested heartbeats (parent re-arm,
+    see :class:`Heartbeat`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: set[Heartbeat] = set()
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def start(self, name: str, **info) -> Heartbeat:
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        hb = Heartbeat(name, self, parent=parent, **info)
+        with self._lock:
+            self._active.add(hb)
+        stack.append(hb)
+        return hb
+
+    def _remove(self, hb: Heartbeat):
+        with self._lock:
+            self._active.discard(hb)
+        # normally a LIFO pop on the creating thread; an exit from
+        # another thread just leaves a harmless dead parent link
+        s = getattr(self._tls, "stack", None)
+        if s and hb in s:
+            s.remove(hb)
+
+    def active(self) -> list[Heartbeat]:
+        with self._lock:
+            return list(self._active)
+
+    def describe(self) -> dict:
+        """``{name: descriptor}`` of every active heartbeat — what a
+        flight dump records so the doctor sees who was mid-work at
+        death (duplicate names keep the oldest-beat entry: the stuck
+        one is the interesting one)."""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        for hb in sorted(self.active(), key=lambda h: h.last_beat):
+            out.setdefault(hb.name, hb.describe(now))
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._active.clear()
+        s = getattr(self._tls, "stack", None)
+        if s:
+            del s[:]
+
+
+class Watchdog:
+    """The no-progress daemon. ``stall_s`` is the flag threshold;
+    ``interval`` the scan period (default ``stall_s / 4``, floored at
+    50 ms so tests with sub-second thresholds stay responsive)."""
+
+    def __init__(self, registry: HeartbeatRegistry,
+                 stall_s: float | None = None,
+                 interval: float | None = None):
+        self.registry = registry
+        self.stall_s = float(stall_s if stall_s is not None
+                             else (_env_stall_s() or DEFAULT_STALL_S))
+        self.interval = float(interval if interval is not None
+                              else max(0.05, self.stall_s / 4.0))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpudl-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.scan()
+            except Exception:  # the observer never kills the observed
+                log.debug("watchdog scan failed", exc_info=True)
+
+    def scan(self) -> list[dict]:
+        """One pass over the active heartbeats; returns the stall
+        events it flagged (tests drive this directly for determinism).
+        Also feeds the flight recorder's metric-tick ring, so a dump
+        carries the metric trajectory sampled at watchdog cadence."""
+        from tpudl.obs import flight as _flight
+        from tpudl.obs import metrics as _metrics
+
+        now = time.monotonic()
+        flagged = []
+        for hb in self.registry.active():
+            if hb.stalled or hb.age(now) <= self.stall_s:
+                continue
+            hb.stalled = True  # one event per episode
+            event = {"ts": time.time(), "name": hb.name,
+                     "info": dict(hb.info), "beats": hb.beats,
+                     "age_s": round(hb.age(now), 3),
+                     "stall_s": self.stall_s,
+                     "in_flight": hb.inflight(now),
+                     "active": sorted(h.name
+                                      for h in self.registry.active()),
+                     "stacks": thread_stacks()}
+            flagged.append(event)
+            _metrics.counter("obs.watchdog.stalls").inc()
+            _flight.get_recorder().record_stall(event)
+            log.warning(
+                "watchdog: %r made no progress for %.1fs (> %.1fs) — "
+                "last info %s; thread stacks recorded in the flight "
+                "recorder", hb.name, hb.age(now), self.stall_s, hb.info)
+        _flight.get_recorder().record_metrics_tick()
+        return flagged
+
+
+_REGISTRY = HeartbeatRegistry()
+_WATCHDOG: Watchdog | None = None
+_WATCHDOG_LOCK = threading.Lock()
+
+
+def get_registry() -> HeartbeatRegistry:
+    return _REGISTRY
+
+
+def heartbeat(name: str, **info) -> Heartbeat:
+    """Register supervised work on the process-wide registry (and
+    lazily start the daemon when ``TPUDL_WATCHDOG_STALL_S`` is set).
+    Use as a context manager; call ``.beat()`` on progress."""
+    _maybe_autostart()
+    return _REGISTRY.start(name, **info)
+
+
+def _maybe_autostart():
+    if _WATCHDOG is None and _env_stall_s() > 0:
+        start_watchdog()
+
+
+def start_watchdog(stall_s: float | None = None,
+                   interval: float | None = None) -> Watchdog:
+    """Start (or return) the process-wide daemon. Explicit args win
+    over ``TPUDL_WATCHDOG_STALL_S``."""
+    global _WATCHDOG
+    with _WATCHDOG_LOCK:
+        if _WATCHDOG is None:
+            _WATCHDOG = Watchdog(_REGISTRY, stall_s=stall_s,
+                                 interval=interval)
+            _WATCHDOG.start()
+        return _WATCHDOG
+
+
+def stop_watchdog():
+    """Stop and forget the daemon (tests)."""
+    global _WATCHDOG
+    with _WATCHDOG_LOCK:
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
+            _WATCHDOG = None

@@ -31,8 +31,10 @@ import numpy as np
 
 from tpudl import distributed as D
 from tpudl import mesh as M
+from tpudl.obs import flight as _obs_flight
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
+from tpudl.obs import watchdog as _obs_watchdog
 from tpudl.train.checkpoint import CheckpointManager
 from tpudl.train.step import make_train_step
 
@@ -114,9 +116,21 @@ class HorovodRunner:
                                       mesh_size=ctx.size):
                     with M.use_mesh(mesh):
                         return main(ctx, **kwargs)
-            except Exception:
+            except Exception as e:
                 attempt += 1
+                # the step the gang died at (train.last_step gauge, set
+                # by Trainer.fit's finally) + the triggering exception
+                # go into the flight recorder: max_restarts exhaustion
+                # then explains WHY, not just how often (the
+                # train.restarts counter alone couldn't)
+                last_step = _obs_metrics.gauge("train.last_step").value
+                _obs_flight.get_recorder().record_restart(
+                    attempt, e, step=last_step,
+                    max_restarts=self.max_restarts)
                 if attempt > self.max_restarts:
+                    _obs_flight.record_error(
+                        "train.exhausted", e, attempts=attempt,
+                        max_restarts=self.max_restarts, step=last_step)
                     raise
                 # restart count is a first-class metric (a silently
                 # restarting gang looks healthy in logs-only setups)
@@ -316,8 +330,15 @@ class Trainer:
         # checkpoint save durations, published run-wide
         step_hist = _obs_metrics.histogram("train.step_seconds")
         ckpt_hist = _obs_metrics.histogram("train.checkpoint_save_seconds")
+        # watchdog heartbeat: one beat per step — a wedged data_fn or a
+        # hung device dispatch flags a stall naming the step it froze
+        # at; train.last_step feeds the runner's restart forensics
+        step_gauge = _obs_metrics.gauge("train.last_step")
+        hb = _obs_watchdog.heartbeat("train.fit", steps=steps,
+                                     start=start)
         try:
             for step in range(start, steps):
+                hb.beat(step=step)
                 t_step = time.perf_counter()
                 batch = data_fn(step)
                 if not isinstance(batch, tuple):
@@ -330,6 +351,7 @@ class Trainer:
                     batch = tuple(M.shard_batch(b, self.mesh) for b in batch)
                 params, opt_state, loss = step_fn(params, opt_state, *batch)
                 step_hist.observe(time.perf_counter() - t_step)
+                step_gauge.set(step + 1)
                 executed += 1
                 examples += int(np.shape(batch[0])[0])
                 done = step + 1
@@ -360,6 +382,7 @@ class Trainer:
                                  "step": np.asarray(steps, np.int64)}, force=True)
                 ckpt_hist.observe(time.perf_counter() - t_ck)
         finally:
+            hb.__exit__(None, None, None)
             if mgr is not None:
                 mgr.close()
             _obs_metrics.counter("train.steps").inc(executed)

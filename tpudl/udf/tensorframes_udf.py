@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
+from tpudl.obs import watchdog as _obs_watchdog
 from tpudl.udf.registry import UDF, register_udf
 
 __all__ = ["makeGraphUDF"]
@@ -109,7 +110,12 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
         # per-UDF observability: calls/rows counters + a latency
         # histogram + a host span, named by the registered udf_name so
         # a SQL query's cost is attributable from one snapshot
-        with _obs_metrics.timed(f"udf.{udf_name}.seconds"), \
+        # the watchdog heartbeat beats once per call; a wedged UDF is a
+        # stall named after the registered udf (the executor's own
+        # per-stage heartbeat runs underneath for stage attribution)
+        with _obs_watchdog.heartbeat(f"udf.{udf_name}",
+                                     rows=len(frame)), \
+                _obs_metrics.timed(f"udf.{udf_name}.seconds"), \
                 _obs_tracer.span(f"udf.{udf_name}", rows=len(frame)):
             # map_batches's default pack already stacks numeric and
             # object-of-array columns (frame._default_pack)
