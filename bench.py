@@ -130,6 +130,68 @@ def _gate(record: dict, key: str) -> bool:
     return False
 
 
+def _sub_deadline_s() -> float:
+    """Per-sub-bench deadline derived from the REMAINING budget: a
+    sub-bench may spend at most ``TPUDL_BENCH_SUBBENCH_FRAC`` (default
+    half) of what's left, floored at 45 s so a short probe still fits.
+    Round 5 proved the between-sub-bench budget gate alone is not
+    enough — one slow sub-bench ate the whole window and the run died
+    rc=124 without a summary line; with the per-sub-bench ceiling the
+    later sub-benches and the final line always get their share."""
+    try:
+        frac = float(os.environ.get("TPUDL_BENCH_SUBBENCH_FRAC", "0.5"))
+    except ValueError:
+        frac = 0.5
+    return max(45.0, _budget_left() * min(1.0, max(0.05, frac)))
+
+
+def _call_with_deadline(key: str, fn, record: dict):
+    """Run one sub-bench on a worker thread under its deadline.
+
+    On expiry the sub-bench is ABANDONED (the daemon thread keeps
+    running — a wedged backend RPC cannot be interrupted from Python,
+    which is exactly the observed failure mode; an abandoned healthy
+    thread merely finishes into the void), the record gains a
+    ``deadline_sub_benches`` entry, the run is flagged partial, and a
+    TimeoutError propagates to the caller's per-sub-bench handler so
+    the loop moves on. The flight recorder notes the event — a later
+    dump shows which sub-bench overran."""
+    deadline = _sub_deadline_s()
+    result: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # re-raised on the caller's thread
+            result["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name=f"bench-{key}")
+    t.start()
+    done.wait(deadline)
+    if not done.is_set():
+        log(f"sub-bench {key} overran its {deadline:.0f}s deadline "
+            f"(budget left {_budget_left():.0f}s) — abandoning it")
+        record.setdefault("deadline_sub_benches", []).append(
+            {"key": key, "deadline_s": round(deadline, 1)})
+        record["partial"] = True
+        try:
+            from tpudl.obs import flight as _flight
+
+            _flight.get_recorder().record_event(
+                "bench.sub_deadline", key=key,
+                deadline_s=round(deadline, 1))
+        except Exception:
+            pass
+        raise TimeoutError(
+            f"sub-bench {key} exceeded {deadline:.0f}s deadline")
+    if "error" in result:
+        raise result["error"]
+    return result.get("value")
+
+
 def _install_sigterm_flush(record: dict):
     """SIGTERM (the driver's kill) flushes whatever has been measured so
     far as the final summary line and exits 0 — the judged record must
@@ -244,6 +306,12 @@ def _compact_summary(record: dict) -> dict:
             # the tpudl.data one-line evidence: u8 ships ~4x fewer
             # bytes; a warm epoch reads ZERO files
             s[k] = _scalar(dp[k])
+    if record.get("bench_sentinel_token") is not None:
+        # one scalar: "ok" / "regress:<metric,metric>" / "insufficient"
+        # — the wire-normalized round-over-round verdict on the judged
+        # line itself (bench_sentinel.summary_token is the one
+        # authority for the format; the full table is in the record)
+        s["sentinel"] = _scalar(record["bench_sentinel_token"])
     if "full_record_path" in record:
         s["full_record"] = record["full_record_path"]
     return s
@@ -1637,8 +1705,17 @@ def main():
         except Exception as e:
             log(f"streaming-mode sub-bench failed: {e!r}")
 
+    feat = None
     if _gate(extra, "featurize_sync_mode"):
-        feat = measure_featurize(n, batch, dtype, trials)
+        try:
+            feat = _call_with_deadline(
+                "featurize_sync_mode",
+                lambda: measure_featurize(n, batch, dtype, trials),
+                extra)
+        except Exception as e:
+            log(f"synchronized featurize sub-bench failed: {e!r}")
+            extra["featurize_sync_mode"] = {"error": repr(e)[:200]}
+    if feat is not None:
         extra.update({
             "featurize_sync_mode": {
                 "value": feat["value"],
@@ -1668,7 +1745,10 @@ def main():
             # likely to wedge a degraded tunnel than 1024's 274 MB
             compute_batch = int(os.environ.get("TPUDL_BENCH_COMPUTE_BATCH",
                                                "256"))
-            compute_ips = measure_compute_only(compute_batch, dtype)
+            compute_ips = _call_with_deadline(
+                "compute_only",
+                lambda: measure_compute_only(compute_batch, dtype),
+                extra)
             extra["compute_only_images_per_sec"] = round(compute_ips, 1)
             extra["compute_only_batch"] = compute_batch
         except Exception as e:  # sub-bench failure must not kill the bench
@@ -1695,7 +1775,9 @@ def main():
             try:
                 # dispatch-free chip-side number (batch 256 profiled best
                 # in the PROFILE.md sweep)
-                dev = measure_device_profile(batch, dtype)
+                dev = _call_with_deadline(
+                    "device_profile",
+                    lambda: measure_device_profile(batch, dtype), extra)
                 if dev:
                     extra["device_profile"] = dev
             except Exception as e:
@@ -1720,7 +1802,10 @@ def main():
                 continue
             try:
                 pre = _quiet_wire_probe() if key in probed else None
-                rec = fn()
+                # per-sub-bench deadline from the remaining budget: an
+                # overrun abandons THIS sub-bench (TimeoutError caught
+                # below), never the rest of the round
+                rec = _call_with_deadline(key, fn, extra)
                 if key in probed and isinstance(rec, dict):
                     rec["h2d_mb_per_sec_pre"] = pre
                     rec["h2d_mb_per_sec_post"] = _quiet_wire_probe()
@@ -1733,7 +1818,8 @@ def main():
     if (os.environ.get("TPUDL_BENCH_SKIP_BASELINE", "0") != "1"
             and _gate(extra, "tf_cpu_baseline")):
         try:
-            base = measure_tf_cpu_baseline()
+            base = _call_with_deadline("tf_cpu_baseline",
+                                       measure_tf_cpu_baseline, extra)
             extra["tf_cpu_baseline_images_per_sec"] = round(base["value"], 2)
             extra["tf_cpu_baseline_trials"] = base["trials"]
         except Exception as e:  # baseline failure must not kill the bench
@@ -1747,6 +1833,24 @@ def main():
         extra["metrics_snapshot"] = _obs.snapshot()
     except Exception as e:
         log(f"metrics snapshot unavailable: {e!r}")
+    try:
+        # regression sentinel: this run's judged numbers vs the
+        # committed round history, wire-normalized so link weather
+        # doesn't read as regression (tools/bench_sentinel.py); the
+        # verdict token rides the judged summary line
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from bench_sentinel import (format_report, sentinel_for_record,
+                                    summary_token)
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        sent = sentinel_for_record(
+            extra, [here, os.path.join(here, "bench_records")])
+        extra["bench_sentinel"] = sent
+        extra["bench_sentinel_token"] = summary_token(sent)[:120]
+        log(format_report(sent))
+    except Exception as e:
+        log(f"bench sentinel failed: {e!r}")
     extra.setdefault("value", None)
     extra["vs_baseline"] = (round(extra["value"] / base["value"], 3)
                             if base and extra["value"] else None)

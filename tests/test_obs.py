@@ -102,32 +102,60 @@ def test_summarize_device_trace():
 def test_persistent_compilation_cache_round_trip(tmp_path, monkeypatch):
     """compilation_cache: second process-equivalent compile of the same
     program must be served from the on-disk cache (observable: cache dir
-    gains entries, and a fresh jit of the same HLO hits it)."""
+    gains entries, and a fresh jit of the same HLO hits it).
+
+    Order-independence (the PR-5 flake): jax's persistent-cache layer is
+    a process-wide singleton initialized at first use — a test earlier
+    in the session may have armed it against a different (or no) dir,
+    after which this test's ``jax_compilation_cache_dir`` update alone
+    does not re-point it. ``reset_cache()`` forces re-initialization
+    against THIS test's tmp dir (before AND after: leave no armed cache
+    behind). The program also embeds a per-run nonce so its HLO can
+    never be served by any in-memory executable another test compiled,
+    and every config knob touched is restored."""
     import jax
     import jax.numpy as jnp
 
     from tpudl.compilation_cache import enable_compilation_cache
 
+    def _reset_persistent_cache():
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # private API drift: best effort
+            pass
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min_time = jax.config.jax_persistent_cache_min_compile_time_secs
+    prev_min_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    _reset_persistent_cache()
     d = str(tmp_path / "xla_cache")
     got = enable_compilation_cache(d)
     assert got == d
     # the production threshold (1s) skips toy programs; force-persist here
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     try:
+        nonce = float(np.random.default_rng().integers(1, 1 << 30))
 
         @jax.jit
         def f(x):
-            return jnp.tanh(x) * 3.0 + x**2
+            return jnp.tanh(x) * 3.0 + x**2 + nonce
 
         x = np.arange(64, dtype=np.float32)
         np.testing.assert_allclose(
-            np.asarray(f(x)), np.tanh(x) * 3.0 + x**2, rtol=1e-6)
+            np.asarray(f(x)), np.tanh(x) * 3.0 + x**2 + nonce, rtol=1e-6)
         import os as _os
 
         entries = [p for p in _os.listdir(d)]
         assert entries, "no cache entries written"
     finally:
-        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min_time)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          prev_min_size)
+        _reset_persistent_cache()
 
 
 def test_compilation_cache_env_disable(monkeypatch):

@@ -697,6 +697,12 @@ class Frame:
                             cache.set_meta({"codecs": plan.keys()})
                 if plan is not None:
                     plan.record_shipped(packed)
+                # wire-byte accounting for the roofline model
+                # (tpudl.obs.roofline): what this batch will put on the
+                # H2D link — nbytes reads a header field, no data touch
+                report.count("bytes_prepared",
+                             int(sum(int(getattr(a, "nbytes", 0))
+                                     for a in packed)))
                 # black-box descriptor: shapes/dtypes/fingerprint only
                 # (never data) — a dump shows what the last batches
                 # looked like (tpudl.obs.flight)
@@ -751,6 +757,10 @@ class Frame:
                     # O(window · batch); a fused entry holds fuse× that,
                     # so big-output runs fall back to per-batch dispatch
                     fuse = 1
+            # rows finished dispatching: the live monitor's progress/ETA
+            # source (rows_done/rows_total on the status file)
+            done_rows = (int(result[0].shape[0]) if result[0].ndim else 1)
+            report.progress(max(0, done_rows - n_pad))
             if mode == "acc":
                 # Keep results device-resident and fetch ONCE per column
                 # at the end: device→host fetch has a large fixed cost

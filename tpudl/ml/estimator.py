@@ -256,7 +256,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         losses = []
         n_steps = 0
         with _obs_watchdog.heartbeat("estimator.train_trial",
-                                     epochs=epochs) as hb, \
+                                     epochs=epochs,
+                                     steps_total=epochs * -(-n // target)
+                                     ) as hb, \
                 _obs_tracer.span("estimator.train_trial", epochs=epochs,
                                  batch_size=target, slice_width=width):
             for _epoch in range(epochs):

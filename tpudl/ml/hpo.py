@@ -94,7 +94,8 @@ class TrialScheduler:
                 # compile, hung RPC) flags a stall naming its index;
                 # the inner train/map_batches heartbeats keep beating
                 # underneath it while healthy
-                with _obs_watchdog.heartbeat("hpo.trial", index=i), \
+                with _obs_watchdog.heartbeat("hpo.trial", index=i,
+                                             of=len(items)), \
                         _obs_tracer.span("hpo.trial", index=i,
                                          slice_width=len(slices[s])):
                     out = i, trial_fn(i, item, slices[s])

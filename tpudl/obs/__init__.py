@@ -30,11 +30,27 @@ The black-box layer (OBSERVABILITY.md "Failure forensics"):
   into the recorder and bump ``obs.watchdog.stalls``;
 - :mod:`tpudl.obs.doctor` — ``python -m tpudl.obs doctor <dump|dir>``
   merges per-host dumps and classifies the failure.
+
+The live ops plane (OBSERVABILITY.md "Live ops plane"):
+
+- :mod:`tpudl.obs.roofline` — per-run roofline attribution:
+  ``obs.analyze_roofline()`` decomposes achieved vs achievable
+  throughput across prepare/wire/dispatch/d2h, publishes
+  ``obs.roofline.*`` gauges, and the knob advisor recommends concrete
+  ``fuse_steps``/``prefetch_depth``/``prepare_workers``/``wire_codec``
+  settings with predicted gain;
+- :mod:`tpudl.obs.live` — every instrumented process writes an atomic
+  ``tpudl-status-<pid>.json`` (``TPUDL_STATUS_DIR``);
+  ``python -m tpudl.obs top <dir>`` renders the refreshing live view.
 """
 
 from __future__ import annotations
 
 from tpudl.obs.flight import dump, get_recorder, record_error
+from tpudl.obs.live import (ensure_status_writer, start_status_writer,
+                            stop_status_writer, write_status)
+from tpudl.obs.roofline import RooflineReport, advise
+from tpudl.obs.roofline import analyze as analyze_roofline
 from tpudl.obs.metrics import (Meter, counter, flush_metrics, gauge,
                                get_registry, histogram, snapshot, timed)
 from tpudl.obs.watchdog import heartbeat, start_watchdog
@@ -62,4 +78,8 @@ __all__ = [
     # failure forensics (flight recorder + watchdog)
     "dump", "get_recorder", "record_error", "heartbeat",
     "start_watchdog",
+    # live ops plane (roofline + status files)
+    "RooflineReport", "analyze_roofline", "advise",
+    "ensure_status_writer", "start_status_writer",
+    "stop_status_writer", "write_status",
 ]

@@ -18,6 +18,13 @@ a CPU-only run gets host totals, a host-blind capture gets device lanes.
 — infeed stall vs decode-error storm vs dispatch slowdown vs clean
 external kill — printing the timeline tail, per-stage throughput at
 time of death, and the suspect stage (:mod:`tpudl.obs.doctor`).
+
+``top <status-dir>`` renders a refreshing terminal view of every live
+``tpudl-status-<pid>.json`` in the directory (written by processes
+running with ``TPUDL_STATUS_DIR`` set): active runs with per-stage
+times, rows done/total + ETA, heartbeat ages, and the roofline/advisor
+verdict. ``--once`` prints one frame and exits (rc 2 when nothing is
+running there). :mod:`tpudl.obs.live` owns the file contract.
 """
 
 from __future__ import annotations
@@ -145,11 +152,24 @@ def main(argv=None) -> int:
     pd.add_argument("path", help="one tpudl-dump-*.json.gz or a dir of them")
     pd.add_argument("--tail", type=int, default=12,
                     help="timeline tail length (default 12 spans)")
+    pp = sub.add_parser(
+        "top", help="live view of tpudl-status-*.json files in a dir")
+    pp.add_argument("status_dir",
+                    help="the TPUDL_STATUS_DIR processes write into")
+    pp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (rc 2 when empty)")
+    pp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
     args = p.parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args.trace_dir, args.out)
     if args.cmd == "doctor":
         return cmd_doctor(args.path, args.tail)
+    if args.cmd == "top":
+        from tpudl.obs import live as L
+
+        return L.top_main(args.status_dir, once=args.once,
+                          interval=args.interval)
     return cmd_metrics(args.path)
 
 

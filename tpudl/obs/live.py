@@ -1,0 +1,421 @@
+"""Live run monitor: atomic per-process status files + ``obs top``.
+
+The forensics layer (flight recorder / doctor) answers "why did it
+die"; this module answers "what is it doing RIGHT NOW". Every
+instrumented process — anything that registers a watchdog heartbeat:
+``Frame.map_batches``, ``Trainer.fit``, estimator trials, UDF calls,
+HPO trials — periodically writes ONE self-contained status file,
+
+    <TPUDL_STATUS_DIR>/tpudl-status-<pid>.json
+
+assembled from the instrumentation that already exists (the pipeline-
+report ring, the heartbeat registry, the metrics registry, and the
+roofline model's current verdict). Writes are atomic (tmp + rename in
+the same directory), so a reader NEVER sees a torn file — the
+``tools/validate_status.py`` contract. File-based on purpose: no
+sockets, nothing to connect to, attachable after the fact, and a
+crashed process leaves its last status behind as evidence.
+
+``python -m tpudl.obs top <dir>`` renders a refreshing terminal view of
+every status file in the directory: active runs with per-stage
+throughput, queue depths, rows done/total + ETA, heartbeat ages, and
+the roofline/advisor verdict. ``--once`` prints a single frame (CI,
+piping, tests).
+
+Overhead: the writer is one daemon thread at ``TPUDL_STATUS_INTERVAL_S``
+(default 1 s) cadence; the executor hot path pays only the one-time
+``ensure_status_writer()`` flag check when a heartbeat registers. The
+<5% executor-overhead guard in tests/test_obs_live.py pins it, same as
+the recorder's.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+from tpudl.obs.metrics import _env_float
+
+__all__ = ["collect_status", "write_status", "ensure_status_writer",
+           "start_status_writer", "stop_status_writer", "status_path",
+           "read_statuses", "render", "SCHEMA", "VERSION",
+           "STATUS_PREFIX"]
+
+SCHEMA = "tpudl-status"
+VERSION = 1
+STATUS_PREFIX = "tpudl-status-"
+
+_METRIC_PREFIXES = ("train.", "hpo.", "udf.", "estimator.",
+                    "obs.watchdog.", "obs.roofline.",
+                    "frame.map_batches.")
+
+
+def _status_dir() -> str | None:
+    return os.environ.get("TPUDL_STATUS_DIR") or None
+
+
+def _interval_s() -> float:
+    return max(0.05, _env_float("TPUDL_STATUS_INTERVAL_S", 1.0))
+
+
+def status_path(status_dir: str, pid: int | None = None) -> str:
+    return os.path.join(status_dir,
+                        f"{STATUS_PREFIX}{pid or os.getpid()}.json")
+
+
+# -- assembly ----------------------------------------------------------------
+
+def _run_entry(report: dict) -> dict:
+    """One pipeline report → the status file's condensed run entry."""
+    rows_total = report.get("rows")
+    rows_done = int(report.get("rows_done") or 0)
+    finished = bool(report.get("finished"))
+    wall = (report.get("wall_seconds") if finished
+            else report.get("age_s")) or 0.0
+    rate = rows_done / wall if wall > 0 else None
+    eta = None
+    if (not finished and rate and rows_total
+            and rows_total > rows_done):
+        eta = (rows_total - rows_done) / rate
+    entry = {
+        "run_id": report.get("run_id"),
+        "rows_total": rows_total,
+        "rows_done": rows_done,
+        "finished": finished,
+        "wall_s": round(wall, 3),
+        "rows_per_sec": round(rate, 2) if rate else None,
+        "eta_s": round(eta, 1) if eta is not None else None,
+        "stage_seconds": report.get("stage_seconds") or {},
+        "overlap_efficiency": report.get("overlap_efficiency"),
+        "queue_depth_mean": report.get("queue_depth_mean"),
+        "config": {k: report.get(k) for k in (
+            "executor", "batch_size", "fuse_steps", "prefetch_depth",
+            "prepare_workers", "wire_codec", "batch_cache")
+            if report.get(k) is not None},
+    }
+    if rows_total:
+        entry["pct"] = round(100.0 * rows_done / rows_total, 1)
+    return entry
+
+
+def collect_status(roofline: bool = True) -> dict:
+    """Assemble one status payload from the live registries. Never
+    raises — a section that fails to assemble is recorded as absent
+    (the observer must not take down the observed)."""
+    payload = {
+        "schema": SCHEMA,
+        "version": VERSION,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "argv": [os.path.basename(sys.argv[0] or "python")]
+        + sys.argv[1:3],
+        "interval_s": _interval_s(),
+        "alive": True,
+        "runs": [],
+        "heartbeats": {},
+        "metrics": {},
+        "roofline": None,
+    }
+    try:
+        from tpudl.obs import pipeline as _pipeline
+
+        reports = list(_pipeline.pipeline_reports().values())
+        # every unfinished run, plus the newest finished one (context
+        # for "what just happened" when the process idles between runs)
+        active = [r for r in reports if not r.get("finished")]
+        done = [r for r in reports if r.get("finished")]
+        keep = active + (done[-1:] if done else [])
+        payload["runs"] = [_run_entry(r) for r in keep]
+        if roofline:
+            newest = (active or done)[-1] if (active or done) else None
+            if newest:
+                from tpudl.obs import roofline as _roofline
+
+                # allow_probe=False: the status thread reads the
+                # CACHED wire figure but never issues a device op (or
+                # drags jax into a host-only process) itself
+                rr = _roofline.analyze(newest, publish=False,
+                                       allow_probe=False)
+                if rr is not None:
+                    payload["roofline"] = rr.to_dict()
+    except Exception:
+        pass
+    try:
+        from tpudl.obs import watchdog as _watchdog
+
+        payload["heartbeats"] = _watchdog.get_registry().describe()
+    except Exception:
+        pass
+    try:
+        from tpudl.obs import metrics as _metrics
+
+        payload["metrics"] = {
+            name: m for name, m in _metrics.snapshot().items()
+            if name.startswith(_METRIC_PREFIXES)}
+    except Exception:
+        pass
+    return payload
+
+
+def write_status(status_dir: str | None = None,
+                 payload: dict | None = None) -> str | None:
+    """Write one atomic status file; returns its path (None on failure
+    or when no directory is configured). tmp + ``os.replace`` in the
+    SAME directory — a reader sees the old complete file or the new
+    complete file, never bytes in between."""
+    status_dir = status_dir or _status_dir()
+    if not status_dir:
+        return None
+    try:
+        payload = payload if payload is not None else collect_status()
+        os.makedirs(status_dir, exist_ok=True)
+        path = status_path(status_dir)
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(payload, default=str))
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None
+
+
+# -- the writer daemon -------------------------------------------------------
+
+class StatusWriter:
+    """Daemon thread: one atomic status write per interval while the
+    process lives; the final write (atexit or ``stop``) flips
+    ``alive: false`` so ``obs top`` shows a clean exit instead of a
+    stale age."""
+
+    def __init__(self, status_dir: str, interval: float | None = None):
+        self.status_dir = status_dir
+        self.interval = float(interval if interval is not None
+                              else _interval_s())
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpudl-status")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        write_status(self.status_dir)  # first frame immediately
+        while not self._stop.wait(self.interval):
+            write_status(self.status_dir)
+
+    def stop(self, final: bool = True):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+        if final:
+            payload = collect_status()
+            payload["alive"] = False
+            write_status(self.status_dir, payload)
+
+
+_WRITER: StatusWriter | None = None
+_WRITER_LOCK = threading.Lock()
+_CHECKED = False  # fast path: ensure() is called per heartbeat
+
+
+def ensure_status_writer() -> "StatusWriter | None":
+    """Lazily start the process-wide writer when ``TPUDL_STATUS_DIR``
+    is set. Called by the heartbeat registrar, so ANY instrumented
+    layer (executor, trainer, estimator, UDFs, HPO) starting work makes
+    the process monitorable without its own plumbing. The post-start
+    cost is one module-flag read."""
+    global _CHECKED
+    if _CHECKED:
+        return _WRITER
+    d = _status_dir()
+    if d is None:
+        # no flag-latch on the None path: an operator can export the
+        # env var mid-process and the next run picks it up
+        return None
+    with _WRITER_LOCK:
+        if _WRITER is None:
+            _start_locked(d, None)
+        _CHECKED = True
+        return _WRITER
+
+
+def start_status_writer(status_dir: str | None = None,
+                        interval: float | None = None) -> StatusWriter:
+    """Start (or return) the process-wide writer. Explicit args win
+    over the env knobs."""
+    global _CHECKED
+    with _WRITER_LOCK:
+        if _WRITER is None:
+            _start_locked(status_dir or _status_dir() or os.getcwd(),
+                          interval)
+        _CHECKED = True
+        return _WRITER
+
+
+def _start_locked(status_dir: str, interval):
+    global _WRITER
+    _WRITER = StatusWriter(status_dir, interval).start()
+    atexit.register(_atexit_stop)
+
+
+def _atexit_stop():
+    w = _WRITER
+    if w is not None:
+        w.stop(final=True)
+
+
+def stop_status_writer():
+    """Stop and forget the writer (tests)."""
+    global _WRITER, _CHECKED
+    with _WRITER_LOCK:
+        if _WRITER is not None:
+            _WRITER.stop(final=False)
+            _WRITER = None
+        _CHECKED = False
+
+
+# -- the reader / renderer (``obs top``) -------------------------------------
+
+def read_statuses(status_dir: str) -> list[dict]:
+    """Parse every status file under ``status_dir`` (newest-written
+    first). A half-readable file is skipped, not fatal — the atomic-
+    write contract means that only happens for foreign files."""
+    out = []
+    try:
+        names = sorted(os.listdir(status_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(STATUS_PREFIX)
+                and name.endswith(".json")):
+            continue
+        path = os.path.join(status_dir, name)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            payload["_path"] = path
+            out.append(payload)
+        except (OSError, json.JSONDecodeError):
+            continue
+    out.sort(key=lambda p: -(p.get("ts") or 0))
+    return out
+
+
+def _bar(pct: float | None, width: int = 20) -> str:
+    if pct is None:
+        return "?" * width
+    filled = int(width * min(100.0, max(0.0, pct)) / 100.0)
+    return "#" * filled + "." * (width - filled)
+
+
+def _fmt_age(s: float) -> str:
+    if s < 120:
+        return f"{s:.1f}s"
+    return f"{s / 60:.1f}m"
+
+
+def render(statuses: list[dict], now: float | None = None) -> str:
+    """One text frame over parsed status payloads — pure (testable)."""
+    now = now if now is not None else time.time()
+    lines = [f"tpudl obs top — {len(statuses)} process(es) — "
+             f"{time.strftime('%H:%M:%S', time.localtime(now))}"]
+    if not statuses:
+        lines.append("  (no tpudl-status-*.json files yet)")
+    for st in statuses:
+        age = now - (st.get("ts") or now)
+        stale_after = 3 * float(st.get("interval_s") or 1.0) + 2.0
+        state = ("EXITED" if not st.get("alive", True)
+                 else ("STALE" if age > stale_after else "live"))
+        lines.append(
+            f"\npid {st.get('pid')} [{state}] "
+            f"{' '.join(st.get('argv') or [])}  "
+            f"(written {_fmt_age(age)} ago on {st.get('host')})")
+        for run in st.get("runs") or []:
+            pct = run.get("pct")
+            state_r = "done" if run.get("finished") else "RUNNING"
+            rate = run.get("rows_per_sec")
+            eta = run.get("eta_s")
+            lines.append(
+                f"  run {run.get('run_id')} [{state_r}] "
+                f"rows {run.get('rows_done')}/{run.get('rows_total')}"
+                + (f" ({pct:.0f}%)" if pct is not None else "")
+                + f" |{_bar(pct)}|"
+                + (f" {rate:.1f} rows/s" if rate else "")
+                + (f" ETA {_fmt_age(eta)}" if eta is not None else ""))
+            ss = run.get("stage_seconds") or {}
+            if ss:
+                stages = "  ".join(f"{k} {v:.2f}s" for k, v
+                                   in sorted(ss.items(), key=lambda kv:
+                                             -kv[1]))
+                lines.append(f"      stages: {stages}")
+            cfg = run.get("config") or {}
+            if cfg:
+                knobs = " ".join(f"{k}={v}" for k, v
+                                 in sorted(cfg.items()))
+                lines.append(f"      knobs:  {knobs}")
+        hbs = st.get("heartbeats") or {}
+        if hbs:
+            parts = []
+            for name, hb in sorted(hbs.items()):
+                inflight = hb.get("in_flight") or {}
+                suspect = (" [" + ",".join(
+                    f"{k}:{v.get('age_s')}s" for k, v
+                    in inflight.items()) + "]") if inflight else ""
+                flag = " STALLED" if hb.get("stalled") else ""
+                parts.append(f"{name} {hb.get('age_s')}s"
+                             f"{suspect}{flag}")
+            lines.append("  heartbeats: " + "; ".join(parts))
+        rl = st.get("roofline") or {}
+        if rl.get("verdict"):
+            lines.append(f"  roofline:   {rl['verdict']}")
+            attr = rl.get("gap_attribution") or {}
+            if attr:
+                shares = "  ".join(
+                    f"{k} {100 * v:.0f}%" for k, v in sorted(
+                        attr.items(), key=lambda kv: -kv[1]) if v)
+                lines.append(f"  gap:        {shares}")
+        m = st.get("metrics") or {}
+        stalls = (m.get("obs.watchdog.stalls") or {}).get("value")
+        step = (m.get("train.last_step") or {}).get("value")
+        bits = []
+        if step is not None:
+            bits.append(f"train.last_step {step:.0f}")
+        if stalls:
+            bits.append(f"watchdog stalls {stalls:.0f}")
+        if bits:
+            lines.append("  metrics:    " + "  ".join(bits))
+    return "\n".join(lines)
+
+
+def top_main(status_dir: str, once: bool = False,
+             interval: float = 2.0, out=None) -> int:
+    """The ``obs top`` loop. ``--once`` prints a single frame and
+    returns 2 when the directory holds no status files (scriptable
+    "is anything running here"); the live loop keeps waiting for
+    processes to appear and exits 0 on Ctrl-C."""
+    out = out or sys.stdout
+    while True:
+        try:
+            statuses = read_statuses(status_dir)
+            frame = render(statuses)
+            if once:
+                print(frame, file=out)
+                return 0 if statuses else 2
+            # clear + home, then the frame (plain ANSI — no curses dep)
+            print("\x1b[2J\x1b[H" + frame, file=out, flush=True)
+            time.sleep(max(0.2, interval))
+        except KeyboardInterrupt:  # pragma: no cover - interactive
+            return 0

@@ -76,6 +76,13 @@ class PipelineReport:
         self.gauges: dict[str, _metrics.Histogram] = {}
         self.wall_seconds = 0.0
         self.config: dict = {}
+        # live progress (fed by the executor's dispatch loop, which
+        # knows batch counts — the live monitor and its ETA read these
+        # instead of inferring progress from counters); rows_total
+        # arrives via config["rows"], rows_done via progress()
+        self.rows_done = 0
+        self.finished = False
+        self._t0 = time.perf_counter()
         # the executor's watchdog heartbeat (set by map_batches): every
         # stage ENTRY beats it with the stage name, so a freeze inside
         # any stage leaves "last progress = entering <stage>" as the
@@ -109,6 +116,13 @@ class PipelineReport:
         with self._lock:
             self.calls[name] = self.calls.get(name, 0) + k
 
+    def progress(self, rows: int):
+        """``rows`` more rows finished dispatching — the executor calls
+        this per handled batch so the run's rows_done/rows_total pair is
+        authoritative (ETA = remaining rows / observed rate)."""
+        with self._lock:
+            self.rows_done += int(rows)
+
     def gauge(self, name: str, value):
         with self._lock:
             h = self.gauges.get(name)
@@ -139,6 +153,7 @@ class PipelineReport:
         double-count, so the executor calls it exactly once)."""
         if wall_seconds is not None:
             self.wall_seconds = wall_seconds
+        self.finished = True
         _metrics.counter("frame.map_batches.runs").inc()
         rows = self.config.get("rows")
         if rows:
@@ -165,6 +180,12 @@ class PipelineReport:
                 "stage_seconds": {k: round(v, 4)
                                   for k, v in sorted(self.stages.items())},
                 "stage_calls": dict(sorted(self.calls.items())),
+                # live-progress triple: rows_done climbs per handled
+                # batch; age_s is wall-so-far for UNFINISHED runs (the
+                # committed wall_seconds stays finish()-only)
+                "rows_done": self.rows_done,
+                "finished": self.finished,
+                "age_s": round(time.perf_counter() - self._t0, 4),
             }
             for name, h in sorted(self.gauges.items()):
                 d = h.to_dict()

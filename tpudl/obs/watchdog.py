@@ -310,8 +310,20 @@ def get_registry() -> HeartbeatRegistry:
 def heartbeat(name: str, **info) -> Heartbeat:
     """Register supervised work on the process-wide registry (and
     lazily start the daemon when ``TPUDL_WATCHDOG_STALL_S`` is set).
-    Use as a context manager; call ``.beat()`` on progress."""
+    Use as a context manager; call ``.beat()`` on progress.
+
+    Registering also arms the live status writer
+    (:mod:`tpudl.obs.live`, ``TPUDL_STATUS_DIR``): any layer that
+    supervises work is by definition work worth watching in
+    ``obs top``, so the one registrar covers executor/trainer/UDF/HPO
+    without per-layer plumbing."""
     _maybe_autostart()
+    try:
+        from tpudl.obs import live as _live
+
+        _live.ensure_status_writer()
+    except Exception:  # the observer never kills the observed
+        pass
     return _REGISTRY.start(name, **info)
 
 

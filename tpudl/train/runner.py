@@ -338,7 +338,10 @@ class Trainer:
                                      start=start)
         try:
             for step in range(start, steps):
-                hb.beat(step=step)
+                # step + examples ride the beat: the live status plane
+                # (obs top) shows training progress from the heartbeat
+                # info without a second instrumentation channel
+                hb.beat(step=step, examples=examples)
                 t_step = time.perf_counter()
                 batch = data_fn(step)
                 if not isinstance(batch, tuple):

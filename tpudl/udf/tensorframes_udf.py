@@ -114,7 +114,8 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
         # stall named after the registered udf (the executor's own
         # per-stage heartbeat runs underneath for stage attribution)
         with _obs_watchdog.heartbeat(f"udf.{udf_name}",
-                                     rows=len(frame)), \
+                                     rows=len(frame),
+                                     batch_size=batch_size), \
                 _obs_metrics.timed(f"udf.{udf_name}.seconds"), \
                 _obs_tracer.span(f"udf.{udf_name}", rows=len(frame)):
             # map_batches's default pack already stacks numeric and
