@@ -306,6 +306,12 @@ def _compact_summary(record: dict) -> dict:
             # the tpudl.data one-line evidence: u8 ships ~4x fewer
             # bytes; a warm epoch reads ZERO files
             s[k] = _scalar(dp[k])
+    pre = record.get("preemption") or {}
+    if pre.get("graceful_kill_rc") is not None:
+        # the robustness one-liners (JOBS.md): graceful kill exits 75,
+        # hard-kill resume rework in seconds (bounded by save_every)
+        s["preempt_rc"] = _scalar(pre.get("graceful_kill_rc"))
+        s["preempt_rework_s"] = _scalar(pre.get("hard_rework_s"))
     if record.get("bench_sentinel_token") is not None:
         # one scalar: "ok" / "regress:<metric,metric>" / "insufficient"
         # — the wire-normalized round-over-round verdict on the judged
@@ -1377,6 +1383,159 @@ def measure_data_pipeline():
     return out
 
 
+def run_preemption_job(workdir, out_path, steps, save_every,
+                       progress_path):
+    """Subprocess body of the preemption sub-bench (``bench.py
+    --preemption-job``): one toy-linreg JobRuntime fit. Writes a result
+    JSON {start_step, wall_s} on completion; a SIGTERM mid-run exits
+    RC_PREEMPTED (75) with resume state in ``workdir``; every step's
+    index is appended to ``progress_path`` so the parent knows how far
+    the killed run got."""
+    import numpy as _np
+
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.jobs import JobRuntime, JobSpec
+    from tpudl.train import Trainer
+
+    rng = _np.random.default_rng(0)
+    X = rng.normal(size=(512, 8)).astype(_np.float32)
+    w_true = rng.normal(size=(8, 1)).astype(_np.float32)
+    yv = X @ w_true + 0.1
+    started = {"step": None}
+
+    def data_fn(step, batch=64):
+        if started["step"] is None:
+            started["step"] = int(step)  # the resume point, observed
+        with open(progress_path, "a") as f:
+            f.write(f"{step}\n")
+        i = (step * batch) % (len(X) - batch + 1)
+        return X[i:i + batch], yv[i:i + batch]
+
+    def loss_fn(p, x, t):
+        return jnp.mean((x @ p["w"] + p["b"] - t) ** 2)
+
+    params0 = {"w": jnp.zeros((8, 1)), "b": jnp.zeros(())}
+    spec = JobSpec("fit", workdir,
+                   material={"model": "bench-linreg", "steps": int(steps)},
+                   save_every=int(save_every))
+    rt = JobRuntime(spec)
+    trainer = Trainer(loss_fn, optax.adam(0.05))
+    t0 = time.perf_counter()
+    rt.run_fit(trainer, params0, data_fn, int(steps),
+               exit_on_preempt=True)
+    with open(out_path, "w") as f:
+        json.dump({"start_step": started["step"] or 0,
+                   "wall_s": time.perf_counter() - t0}, f)
+
+
+def measure_preemption(steps=None, save_every=25):
+    """The robustness sub-bench (JOBS.md): kill a JobRuntime fit at
+    ~50% of its measured budget and measure RESUME REWORK — the
+    seconds the relaunched run spends re-executing steps it had already
+    done. Two kills: SIGTERM (graceful — the runtime checkpoints at the
+    boundary, expected rework ≈ 0 and rc=75) and SIGKILL (hard — no
+    boundary, rework bounded by ``save_every`` steps). The judged line
+    carries ``preempt_rework_s`` (the hard-kill figure: the honest
+    worst case) and the graceful rc."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    steps = int(steps if steps is not None
+                else os.environ.get("TPUDL_BENCH_PREEMPT_STEPS", "300"))
+    base = tempfile.mkdtemp(prefix="tpudl-bench-preempt-")
+    me = os.path.abspath(__file__)
+
+    def launch(tag, workdir):
+        out = os.path.join(base, f"{tag}.json")
+        progress = os.path.join(base, f"{tag}.progress")
+        cmd = [sys.executable, me, "--preemption-job", workdir, out,
+               str(steps), str(save_every), progress]
+        return cmd, out, progress
+
+    def last_progress(progress):
+        try:
+            with open(progress) as f:
+                lines = f.read().split()
+            return int(lines[-1]) if lines else 0
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    rec = {"steps": steps, "save_every": save_every}
+    # 1) uninterrupted reference: the 100% budget + per-step seconds.
+    # per_step comes from the CHILD's own run_fit wall clock (written
+    # to its result JSON), not the subprocess wall — interpreter + jax
+    # import dominate the latter, and rework seconds derived from it
+    # would mostly measure startup, not rework
+    cmd, out, _prog = launch("ref", os.path.join(base, "ref_job"))
+    t0 = time.perf_counter()
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    t_full = time.perf_counter() - t0
+    if r.returncode != 0:
+        raise RuntimeError(f"reference job failed rc={r.returncode}: "
+                           f"{r.stderr[-400:]}")
+    with open(out) as f:
+        ref_res = json.load(f)
+    per_step = float(ref_res["wall_s"]) / max(1, steps)
+    rec["full_run_s"] = round(t_full, 3)
+    rec["fit_wall_s"] = round(float(ref_res["wall_s"]), 3)
+    rec["per_step_s"] = round(per_step, 5)
+
+    for tag, sig, rc_expected in (("graceful", signal.SIGTERM, 75),
+                                  ("hard", signal.SIGKILL, -9)):
+        workdir = os.path.join(base, f"{tag}_job")
+        cmd, out, prog = launch(tag, workdir)
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        # the 50%-budget kill point, measured in actual step progress
+        # (wall-clock timing would race the child's interpreter/jax
+        # startup and kill before the runtime even armed its handler)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if last_progress(prog) >= steps // 2 \
+                    or proc.poll() is not None:
+                break
+            time.sleep(0.02)
+        proc.send_signal(sig)
+        try:
+            rc = proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = proc.wait()
+        at_kill = last_progress(prog)
+        rec[f"{tag}_kill_rc"] = rc
+        rec[f"{tag}_kill_step"] = at_kill
+        rec[f"{tag}_rc_contract"] = (rc == rc_expected)
+        # relaunch the SAME spec → must complete, resuming from the
+        # persisted state
+        cmd2, out2, _ = launch(f"{tag}_resume", workdir)
+        r2 = subprocess.run(cmd2, capture_output=True, text=True,
+                            timeout=600)
+        if r2.returncode != 0:
+            rec[f"{tag}_resume_error"] = r2.stderr[-300:]
+            continue
+        with open(out2) as f:
+            res = json.load(f)
+        start = int(res.get("start_step") or 0)
+        rework = max(0, at_kill - start)
+        rec[f"{tag}_resume_start_step"] = start
+        rec[f"{tag}_rework_steps"] = rework
+        rec[f"{tag}_rework_s"] = round(rework * per_step, 4)
+        rec[f"{tag}_resume_wall_s"] = round(float(res.get("wall_s", 0)), 3)
+    # rework bound audit: hard-kill rework must stay ≤ save_every
+    if isinstance(rec.get("hard_rework_steps"), int):
+        rec["hard_rework_bounded"] = (rec["hard_rework_steps"]
+                                      <= save_every)
+    log(f"preemption: graceful rc={rec.get('graceful_kill_rc')} "
+        f"rework={rec.get('graceful_rework_steps')} steps; hard "
+        f"rework={rec.get('hard_rework_steps')} steps "
+        f"({rec.get('hard_rework_s')}s, save_every={save_every})")
+    shutil.rmtree(base, ignore_errors=True)
+    return rec
+
+
 def measure_flash_attention():
     """Pallas flash-attention kernel vs dense XLA attention on the live
     backend (causal, H=8, D=128) at an S-SCALING ladder — round-3
@@ -1797,6 +1956,7 @@ def main():
                         ("estimator_inception", measure_estimator_inception),
                         ("decode", measure_decode),
                         ("data_pipeline", measure_data_pipeline),
+                        ("preemption", measure_preemption),
                         ("flash_attention", measure_flash_attention)]:
             if not _gate(extra, key):
                 continue
@@ -1864,5 +2024,8 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--featurize-trial":
         arm, trial_n, trial_batch, trial_dtype = sys.argv[2:6]
         run_featurize_trial(arm, int(trial_n), int(trial_batch), trial_dtype)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--preemption-job":
+        wd, outp, n_steps, save_ev, progp = sys.argv[2:7]
+        run_preemption_job(wd, outp, int(n_steps), int(save_ev), progp)
     else:
         main()

@@ -583,16 +583,20 @@ class TestRestartForensics:
         assert "nan loss" in restarts[0]["traceback"]
 
     def test_exhaustion_records_error_ring(self, forensics):
-        from tpudl.train import HorovodRunner
+        from tpudl.train import HorovodRunner, RestartsExhausted
 
         def always_fails(ctx):
             raise ValueError("poisoned batch")
 
         try:
-            with pytest.raises(ValueError):
+            # budget exhaustion raises the TYPED RestartsExhausted
+            # carrying the last cause (the jobs-runtime contract)
+            with pytest.raises(RestartsExhausted,
+                               match="poisoned batch") as ei:
                 HorovodRunner(np=1, max_restarts=1).run(always_fails)
         except AttributeError as e:
             pytest.skip(f"mesh API unavailable in this jax: {e}")
+        assert isinstance(ei.value.last_cause, ValueError)
         snap = forensics.snapshot()
         assert len(snap["restarts"]) == 2  # both attempts recorded
         kinds = [e["kind"] for e in snap["errors"]]

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Integrity audit for tpudl.jobs resume state (the 5th validator).
+
+The offline twin of ``tools/validate_shards.py`` / ``validate_dump.py``
+(wired into tier-1 the same way — tests/test_jobs.py loads this module
+and drives it over real and deliberately-damaged job workdirs): given a
+job workdir (or a directory of workdirs) it audits the resume manifest
+a re-launched :class:`tpudl.jobs.JobRuntime` would bet its resume on:
+
+- **schema** — ``job-manifest.json`` fields, types, status/kind enums,
+  a 40-hex fingerprint;
+- **cursor ≤ bounds** — the data cursor (epoch/batch/step) must sit
+  inside the recorded dataset/step bounds (a cursor past the end can
+  silently skip the whole resume);
+- **checkpoint ↔ cursor consistency** — the recorded checkpoint step
+  must exist in the checkpoint directory's own manifest and must not
+  be AHEAD of the cursor (a checkpoint from the future means the
+  cursor write was lost — resume would replay into trained state);
+- **trial ledger** — done/in_flight/pending must be disjoint and
+  within the trial bounds;
+- **checkpoint payloads** — size + crc32 per the checkpoint manifest
+  (delegated shape of train/checkpoint.py's contract, without
+  importing tpudl: validators stay pure stdlib + numpy).
+
+Exit 0 = every manifest audited is internally consistent. Importable
+(``from validate_job import validate_workdir``) and runnable
+(``python tools/validate_job.py <workdir>``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+
+MANIFEST_NAME = "job-manifest.json"
+MANIFEST_SCHEMA = "tpudl-job-manifest"
+MANIFEST_VERSION = 1
+CKPT_MANIFEST_NAME = "ckpt-manifest.json"
+CKPT_MANIFEST_SCHEMA = "tpudl-checkpoint-manifest"
+
+STATUSES = ("running", "preempted", "done", "failed")
+KINDS = ("fit", "estimator_fit", "featurize", "hpo", "custom")
+_NUM = (int, float)
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _is_hex(s, n: int) -> bool:
+    return (isinstance(s, str) and len(s) == n
+            and all(c in "0123456789abcdef" for c in s))
+
+
+def _check_checkpoints(workdir: str, m: dict, errs: list[str]) -> None:
+    """Checkpoint-dir audit + the checkpoint-step ↔ cursor rule."""
+    where = os.path.join(workdir, MANIFEST_NAME)
+    ck = m.get("checkpoint")
+    if ck is None:
+        return
+    if not isinstance(ck, dict):
+        errs.append(f"{where}: checkpoint is not an object")
+        return
+    ck_dir = os.path.join(workdir, str(ck.get("dir") or "checkpoints"))
+    step = ck.get("step")
+    if step is None:
+        return  # no checkpoint taken yet — nothing to cross-check
+    if not isinstance(step, int) or step < 0:
+        errs.append(f"{where}: checkpoint.step {step!r} is not a "
+                    "non-negative integer")
+        return
+    # the pointer must resolve: the checkpoint manifest knows the step
+    # and its payload passes size+crc (a resume would load exactly this)
+    cman_path = os.path.join(ck_dir, CKPT_MANIFEST_NAME)
+    try:
+        with open(cman_path) as f:
+            cman = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        errs.append(f"{where}: checkpoint.step {step} but checkpoint "
+                    f"manifest unreadable ({e})")
+        return
+    if (not isinstance(cman, dict)
+            or cman.get("schema") != CKPT_MANIFEST_SCHEMA
+            or not isinstance(cman.get("checkpoints"), dict)):
+        errs.append(f"{cman_path}: not a {CKPT_MANIFEST_SCHEMA} manifest")
+        return
+    entry = cman["checkpoints"].get(str(step))
+    if entry is None:
+        errs.append(f"{where}: checkpoint.step {step} not present in "
+                    f"{cman_path}")
+    else:
+        fpath = os.path.join(ck_dir, str(entry.get("file")))
+        try:
+            size = os.stat(fpath).st_size
+        except OSError:
+            errs.append(f"{cman_path}: step {step} file missing "
+                        f"({entry.get('file')})")
+            return
+        if size != entry.get("nbytes"):
+            errs.append(f"{cman_path}: step {step} size {size} != "
+                        f"manifest {entry.get('nbytes')} (truncated?)")
+        elif _crc32_file(fpath) != entry.get("crc32"):
+            errs.append(f"{cman_path}: step {step} crc32 mismatch")
+    cursor = m.get("cursor") or {}
+    cur_step = cursor.get("step")
+    if isinstance(cur_step, int) and step > cur_step:
+        errs.append(
+            f"{where}: checkpoint.step {step} is AHEAD of cursor.step "
+            f"{cur_step} — the cursor write was lost; resume would "
+            "replay data into already-trained state")
+
+
+def validate_manifest(workdir: str) -> list[str]:
+    """All integrity errors for one job workdir (empty = clean)."""
+    errs: list[str] = []
+    path = os.path.join(workdir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable manifest ({e})"]
+    if not isinstance(m, dict):
+        return [f"{path}: manifest is not a JSON object"]
+    if m.get("schema") != MANIFEST_SCHEMA:
+        errs.append(f"{path}: schema {m.get('schema')!r} != "
+                    f"{MANIFEST_SCHEMA!r}")
+    if m.get("version") != MANIFEST_VERSION:
+        errs.append(f"{path}: version {m.get('version')!r} != "
+                    f"{MANIFEST_VERSION}")
+    if not _is_hex(m.get("fingerprint"), 40):
+        errs.append(f"{path}: fingerprint is not 40-hex")
+    if m.get("kind") not in KINDS:
+        errs.append(f"{path}: kind {m.get('kind')!r} not in {KINDS}")
+    if m.get("status") not in STATUSES:
+        errs.append(f"{path}: status {m.get('status')!r} not in "
+                    f"{STATUSES}")
+    if not isinstance(m.get("attempt"), int) or m.get("attempt") < 1:
+        errs.append(f"{path}: attempt must be an integer >= 1")
+    for ts_key in ("created_ts", "updated_ts"):
+        if not isinstance(m.get(ts_key), _NUM):
+            errs.append(f"{path}: {ts_key} missing or non-numeric")
+
+    cursor = m.get("cursor")
+    if not isinstance(cursor, dict):
+        errs.append(f"{path}: cursor missing or not an object")
+        cursor = {}
+    bounds = m.get("bounds")
+    if bounds is not None and not isinstance(bounds, dict):
+        errs.append(f"{path}: bounds is not an object")
+        bounds = {}
+    bounds = bounds or {}
+    for k, v in cursor.items():
+        if not isinstance(v, int) or v < 0:
+            errs.append(f"{path}: cursor.{k} {v!r} is not a "
+                        "non-negative integer")
+    # cursor ≤ bounds: epoch ≤ epochs, batch ≤ batches_per_epoch,
+    # step ≤ steps (== is legal: the final cursor sits ON the bound)
+    for ck, bk in (("epoch", "epochs"),
+                   ("batch", "batches_per_epoch"),
+                   ("step", "steps")):
+        cv, bv = cursor.get(ck), bounds.get(bk)
+        if isinstance(cv, int) and isinstance(bv, int) and cv > bv:
+            errs.append(f"{path}: cursor.{ck} {cv} exceeds "
+                        f"bounds.{bk} {bv}")
+
+    trials = m.get("trials")
+    if trials is not None:
+        if not isinstance(trials, dict):
+            errs.append(f"{path}: trials is not an object")
+        else:
+            done = trials.get("done")
+            if not isinstance(done, dict):
+                errs.append(f"{path}: trials.done is not an object")
+                done = {}
+            sets = {"done": {int(k) for k in done
+                             if str(k).lstrip("-").isdigit()}}
+            for key in ("in_flight", "pending"):
+                v = trials.get(key)
+                if not isinstance(v, list):
+                    errs.append(f"{path}: trials.{key} is not a list")
+                    v = []
+                sets[key] = {int(x) for x in v if isinstance(x, int)}
+            for a in ("done", "in_flight", "pending"):
+                for b in ("done", "in_flight", "pending"):
+                    if a < b and sets[a] & sets[b]:
+                        errs.append(
+                            f"{path}: trials.{a} and trials.{b} overlap "
+                            f"({sorted(sets[a] & sets[b])[:4]})")
+            n_trials = bounds.get("trials")
+            if isinstance(n_trials, int):
+                allidx = sets["done"] | sets["in_flight"] | sets["pending"]
+                bad = [i for i in allidx if i >= n_trials or i < 0]
+                if bad:
+                    errs.append(f"{path}: trial indices {bad[:4]} out of "
+                                f"bounds.trials {n_trials}")
+
+    _check_checkpoints(workdir, m, errs)
+    return errs
+
+
+def validate_workdir(root: str) -> tuple[list[str], int]:
+    """(errors, n_manifests) over ``root`` — itself a workdir, or a
+    directory of workdirs."""
+    workdirs = []
+    if os.path.isfile(os.path.join(root, MANIFEST_NAME)):
+        workdirs.append(root)
+    else:
+        try:
+            children = sorted(os.listdir(root))
+        except OSError as e:
+            return [f"{root}: unreadable ({e})"], 0
+        for name in children:
+            sub = os.path.join(root, name)
+            if os.path.isfile(os.path.join(sub, MANIFEST_NAME)):
+                workdirs.append(sub)
+    if not workdirs:
+        return [f"{root}: no {MANIFEST_NAME} found"], 0
+    errors: list[str] = []
+    for wd in workdirs:
+        errors.extend(validate_manifest(wd))
+    return errors, len(workdirs)
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: validate_job.py <job_workdir>", file=sys.stderr)
+        return 2
+    errors, n = validate_workdir(argv[1])
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    print(f"{argv[1]}: {n} job manifest(s), "
+          f"{'OK' if not errors else str(len(errors)) + ' errors'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

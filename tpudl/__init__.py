@@ -35,6 +35,10 @@ _LAZY = {
     "registerKerasImageUDF": "tpudl.udf.keras_image_model",
     "GraphFunction": "tpudl.ingest",
     "IsolatedSession": "tpudl.ingest",
+    # preemption-survivable job runtime (JOBS.md)
+    "JobSpec": "tpudl.jobs",
+    "JobRuntime": "tpudl.jobs",
+    "RetryPolicy": "tpudl.jobs",
     # wire-aware dataset subsystem (DATA.md)
     "Dataset": "tpudl.data",
     "U8Codec": "tpudl.data",

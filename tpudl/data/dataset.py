@@ -95,6 +95,11 @@ def cached_uri_load(loader, uris, cache_dir: str, *,
         if hit is not None:
             parts.append(hit[0])
             continue
+        # transient IO retries live INSIDE the load, at per-file
+        # granularity (load_uri_batch / the loader's reads, kinds
+        # imageio.read + data.uri_load): a chunk-level retry here
+        # would re-decode all ~256 good images to re-attempt one bad
+        # read, multiplying the per-file attempts already taken
         batch = load_uri_batch(loader, uris[start:start + chunk])
         cache.put(idx, [batch])
         parts.append(batch)

@@ -49,7 +49,9 @@ import zlib
 
 import numpy as np
 
-__all__ = ["ShardCache", "ShardCorruption", "cache_key",
+from tpudl.testing import faults as _faults
+
+__all__ = ["ShardCache", "ShardCorruption", "ShardEvicted", "cache_key",
            "MANIFEST_NAME", "MANIFEST_VERSION"]
 
 MANIFEST_NAME = "manifest.json"
@@ -59,6 +61,15 @@ MANIFEST_VERSION = 1
 class ShardCorruption(Exception):
     """A shard failed its integrity check (internal control flow: `get`
     converts it into a miss)."""
+
+
+class ShardEvicted(ShardCorruption):
+    """The shard FILE is gone — deleted by a concurrent eviction
+    (another process's ``_drop``/``clear``) between our manifest read
+    and the open. Split from corruption so the miss is counted as
+    ``data.cache.evicted``, NOT ``data.cache.corrupt``: an eviction
+    race is normal cache churn, and counting it as corruption would
+    feed false decode-error-storm evidence to ``obs doctor``."""
 
 
 def cache_key(material: str, **parts) -> str:
@@ -203,12 +214,16 @@ class ShardCache:
             return len(self._shards)
 
     def _check_file(self, fmeta: dict) -> str:
-        """Path of a verified shard file, or raise ShardCorruption."""
+        """Path of a verified shard file, or raise ShardCorruption
+        (ShardEvicted when the file is simply gone)."""
         path = os.path.join(self.dir, fmeta["name"])
         try:
             size = os.stat(path).st_size
+        except FileNotFoundError as e:
+            raise ShardEvicted(f"shard file {path} deleted (concurrent "
+                               "eviction)") from e
         except OSError as e:
-            raise ShardCorruption(f"missing shard file {path}") from e
+            raise ShardCorruption(f"unreadable shard file {path}") from e
         if size != fmeta["nbytes"]:
             raise ShardCorruption(
                 f"{path}: size {size} != manifest {fmeta['nbytes']} "
@@ -244,13 +259,33 @@ class ShardCache:
             arrays = []
             for fmeta in entry["files"]:
                 path = self._check_file(fmeta)
-                arr = np.load(path, mmap_mode="r", allow_pickle=False)
+                # fault point (tpudl.testing.faults): the robustness
+                # suite corrupts or deletes the file exactly HERE —
+                # between the integrity check and the open — to pin the
+                # read-path races deterministically
+                _faults.fire("shards.read", path=path, index=int(index))
+                try:
+                    arr = np.load(path, mmap_mode="r", allow_pickle=False)
+                except FileNotFoundError as e:
+                    # deleted between _check_file's stat and the open:
+                    # the concurrent-eviction race, a plain miss
+                    raise ShardEvicted(
+                        f"shard file {path} deleted between check and "
+                        "read (concurrent eviction)") from e
                 if (list(arr.shape) != list(fmeta["shape"])
                         or str(arr.dtype) != fmeta["dtype"]):
                     raise ShardCorruption(
                         f"{path}: header {arr.dtype}{arr.shape} != manifest "
                         f"{fmeta['dtype']}{tuple(fmeta['shape'])}")
                 arrays.append(arr)
+        except ShardEvicted:
+            # NOT corruption: no corrupt counter, no error-ring sample —
+            # a concurrent eviction must never read as a decode-error
+            # storm to obs doctor. Still a miss: the caller re-prepares.
+            _m.counter("data.cache.evicted").inc()
+            _m.counter("data.cache.misses").inc()
+            self._forget(index)
+            return None
         except (ShardCorruption, OSError, ValueError) as e:
             _m.counter("data.cache.corrupt").inc()
             _m.counter("data.cache.misses").inc()
@@ -282,6 +317,14 @@ class ShardCache:
             for k, v in fresh.items():
                 self._shards.setdefault(k, v)
 
+    def _forget(self, index: int) -> None:
+        """Drop one manifest entry WITHOUT unlinking its files — used
+        on the eviction race, where another process already owns the
+        deletion (unlinking here could race a concurrent re-``put``)."""
+        with self._lock:
+            if self._shards.pop(str(index), None) is not None:
+                self._write_manifest_locked()
+
     def _drop(self, index: int, reason: str = "") -> None:
         with self._lock:
             entry = self._shards.pop(str(index), None)
@@ -299,18 +342,31 @@ class ShardCache:
         atomically; overwrites any previous entry for ``index``."""
         from tpudl.obs import metrics as _m
 
+        from tpudl.jobs.retry import io_policy
+
         files, total = [], 0
         for j, arr in enumerate(arrays):
             arr = np.ascontiguousarray(arr)
             name = f"shard-{int(index):06d}-c{j}.npy"
             path = os.path.join(self.dir, name)
             tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
-            try:
+
+            def _write_one(tmp=tmp, path=path, arr=arr):
+                _faults.fire("shards.write", path=path)
                 with open(tmp, "wb") as f:
                     np.save(f, arr, allow_pickle=False)
                 crc = _crc32_file(tmp)
                 nbytes = os.stat(tmp).st_size
                 os.replace(tmp, path)
+                return crc, nbytes
+
+            try:
+                # transient write failures (flaky NFS, brief ENOSPC)
+                # retry under the shared IO policy; a persistent one
+                # still fails OPEN — the cache stays cold for this
+                # entry, it never crashes the run
+                crc, nbytes = io_policy().call(_write_one,
+                                               kind="data.cache.write")
             except OSError:
                 try:
                     os.unlink(tmp)

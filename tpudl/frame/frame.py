@@ -24,6 +24,8 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from tpudl.testing import faults as _faults
+
 __all__ = ["Frame", "LazyColumn", "concat"]
 
 
@@ -652,6 +654,10 @@ class Frame:
             check must see restored float values, not wire bytes)."""
             with report.stage("prepare"):
                 bidx = start // batch_size
+                # executor-stage fault points (tpudl.testing.faults):
+                # the robustness suite raises/kills inside an exact
+                # stage at an exact batch; unarmed this is a None-check
+                _faults.fire("frame.prepare", index=bidx)
                 packed = None
                 cache_hit = False
                 if cache is not None:
@@ -715,6 +721,7 @@ class Frame:
                     # every column slices the same rows, so one pad count
                     # serves
                     with report.stage("h2d"):
+                        _faults.fire("frame.h2d", index=bidx)
                         padded = [M.pad_batch(arr, multiple) for arr in packed]
                         n_pad = padded[0][1] if padded else 0
                         packed = [M.shard_batch(p, mesh) for p, _ in padded]
@@ -781,6 +788,7 @@ class Frame:
                 pending.append((tuple(result), n_pad))
                 if len(pending) > _PIPELINE_WINDOW:
                     with report.stage("d2h"):
+                        _faults.fire("frame.d2h")
                         _drain(pending.pop(0), outputs)
 
         spans = list(self.iter_batches(batch_size))
@@ -834,17 +842,21 @@ class Frame:
                             # group per-batch
                             for packed, n_pad in group:
                                 with report.stage("dispatch"):
+                                    _faults.fire("frame.dispatch",
+                                                 index=consumed)
                                     result = _run_fn()(*packed)
                                 handle(result, n_pad)
                             continue
                         fused_fn = _fused_wrapper(_run_fn(), fuse)
                         with report.stage("dispatch"):
+                            _faults.fire("frame.dispatch", index=consumed)
                             result = fused_fn(*stacked)
                         report.count("fused_dispatches")
                         handle(result, 0)
                     else:
                         packed, n_pad = next_prepared()
                         with report.stage("dispatch"):
+                            _faults.fire("frame.dispatch", index=consumed)
                             result = _run_fn()(*packed)
                         handle(result, n_pad)
             finally:
@@ -854,9 +866,11 @@ class Frame:
                     cache.flush()  # persist throttled manifest entries
             while pending:
                 with report.stage("d2h"):
+                    _faults.fire("frame.d2h")
                     _drain(pending.pop(0), outputs)
             if mode == "acc":
                 with report.stage("d2h"):
+                    _faults.fire("frame.d2h")
                     _fetch_accumulated(acc, segs, outputs)
         finally:
             # the final d2h drain runs supervised too (a wedged fetch

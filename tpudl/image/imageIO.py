@@ -330,9 +330,17 @@ def createNativeImageLoader(height: int, width: int, scale: float = 1.0,
         return np.asarray(img, np.float32) * scale
 
     def _read_all(uris: list) -> list:
+        from tpudl.jobs.retry import io_policy
+        from tpudl.testing import faults as _faults
+
         def _read(u):
-            with open(u, "rb") as f:
-                return f.read()
+            def _once():
+                _faults.fire("io.read", path=str(u))
+                with open(u, "rb") as f:
+                    return f.read()
+
+            # same transient-IO retry as LazyFileColumn._read_raw
+            return io_policy().call(_once, kind="imageio.read")
 
         raws = _parallel_map(
             _read, uris,
@@ -493,8 +501,20 @@ class LazyFileColumn(LazyColumn):
         return len(self._paths)
 
     def _read_raw(self, i: int) -> bytes:
-        with open(self._paths[i], "rb") as f:
-            raw = f.read()
+        from tpudl.jobs.retry import io_policy
+        from tpudl.testing import faults as _faults
+
+        def _read():
+            # fault point: the robustness suite injects transient IO
+            # errors (recovery-after-K) exactly here
+            _faults.fire("io.read", path=str(self._paths[i]))
+            with open(self._paths[i], "rb") as f:
+                return f.read()
+
+        # flaky-storage reads retry under the shared IO policy (bounded
+        # backoff; every attempt lands in retry.* counters + the flight
+        # recorder) instead of poisoning the row on the first EIO
+        raw = io_policy().call(_read, kind="imageio.read")
         with self._reads_lock:
             self.reads += 1
         return raw
@@ -685,7 +705,14 @@ def filesToFrame(path, numPartitions: int | None = None,
 def _decode_row(decode_f, origin, raw):
     """decode_f semantics shared by the eager and lazy read paths
     (ref: readImagesWithCustomFn ~L220): exceptions/None → None row;
-    ndarray results are wrapped into structs with the file origin."""
+    ndarray results are wrapped into structs with the file origin.
+
+    Deliberately NOT retried: ``raw`` is already in memory, so a decode
+    failure is deterministic — bad bytes are bad forever, and PIL
+    raises OSError-shaped errors for truncated images, which a retry
+    policy would misread as transient and re-decode with backoff,
+    burning the prepare pool. The transient-IO retry lives on the READ
+    side (``_read_raw`` / ``_read_all``), where flakiness is real."""
     try:
         out = decode_f(raw)
     except Exception as e:

@@ -1,0 +1,42 @@
+"""tpudl.jobs — the preemption-survivable job runtime (JOBS.md).
+
+Any ``Trainer.fit`` / ``KerasImageFileEstimator.fit`` / bulk
+``featurize`` / ``TrialScheduler.run`` is describable as a
+:class:`JobSpec`; a :class:`JobRuntime` runs it with persistent resume
+state (checkpoint + data cursor + trial ledger, one atomic manifest),
+turns SIGTERM into checkpoint-then-exit with ``RC_PREEMPTED`` (75),
+and resumes a re-launched identical spec with bounded rework.
+:class:`RetryPolicy` is the shared transient-failure policy every
+layer applies (gang restarts, shard/image IO, HPO trials).
+
+Imports are lazy (PEP 562): the runtime pulls in ``tpudl.train``,
+while ``tpudl.jobs.retry`` is imported BY ``tpudl.train`` — the lazy
+surface keeps that cycle one-directional.
+"""
+
+import importlib
+
+_LAZY = {
+    "JobSpec": "tpudl.jobs.spec",
+    "fingerprint_material": "tpudl.jobs.spec",
+    "JobRuntime": "tpudl.jobs.runtime",
+    "JobContext": "tpudl.jobs.runtime",
+    "JobPreempted": "tpudl.jobs.runtime",
+    "RC_PREEMPTED": "tpudl.jobs.runtime",
+    "load_manifest": "tpudl.jobs.runtime",
+    "RetryPolicy": "tpudl.jobs.retry",
+    "io_policy": "tpudl.jobs.retry",
+    "is_fatal": "tpudl.jobs.retry",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module 'tpudl.jobs' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1,0 +1,429 @@
+"""JobRuntime — preemption-survivable execution of one JobSpec.
+
+The glue ROADMAP item 5 asks for: checkpoint manager (train/
+checkpoint.py), epoch-replay cache (tpudl.data), restart forensics
+(tpudl.obs.flight) and the trial scheduler (tpudl.ml.hpo) already
+exist — this module binds them into a runtime where an external
+SIGTERM is a *recovery* event, not a forensics event:
+
+- ``JobRuntime(spec).run(fn)`` executes ``fn(ctx)`` with a persistent
+  **resume manifest** (``job-manifest.json`` in the spec's workdir,
+  written tmp+``os.replace`` — the shard-manifest atomicity contract)
+  holding the unified resume state: model checkpoint pointer, data
+  cursor (epoch + batch index into ``Dataset.iter_epoch``), and HPO
+  trial ledger (done / in-flight / pending);
+- on **SIGTERM** the runtime sets a stop flag; the run reaches its
+  next step/batch/trial boundary, checkpoints, persists the cursor,
+  writes a ``preempted_resumable`` flight dump INTO the workdir
+  (``obs doctor`` classifies it as such — the dump carries the
+  manifest pointer), and exits with the distinct
+  ``RC_PREEMPTED = 75`` (EX_TEMPFAIL: "transient failure, re-run me");
+- a re-launched runtime over the SAME spec (fingerprints must match —
+  resuming a different job's state is refused) picks up the cursor and
+  checkpoint: rework is bounded to ≤ ``save_every`` train steps and
+  ≤ 1 batch of data prep, and resumed epochs ride the prepared-batch
+  cache (zero re-decodes for already-prepared batches).
+
+The kill-mid-epoch acceptance test (tests/test_jobs.py) proves the
+contract end to end: SIGTERM'd run + relaunch == uninterrupted run,
+bit-identical final params.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+from tpudl.jobs.spec import JobSpec
+
+__all__ = ["JobRuntime", "JobContext", "JobPreempted", "RC_PREEMPTED",
+           "MANIFEST_NAME", "MANIFEST_SCHEMA", "MANIFEST_VERSION",
+           "load_manifest"]
+
+RC_PREEMPTED = 75  # EX_TEMPFAIL: preempted but resumable — re-run me
+MANIFEST_NAME = "job-manifest.json"
+MANIFEST_SCHEMA = "tpudl-job-manifest"
+MANIFEST_VERSION = 1
+
+STATUSES = ("running", "preempted", "done", "failed")
+
+
+class JobPreempted(Exception):
+    """The run was preempted at a safe boundary; its resume state is
+    persisted in ``manifest_path``. Marked ``tpudl_fatal``: no retry
+    layer may swallow a preemption."""
+
+    tpudl_fatal = True
+
+    def __init__(self, manifest_path: str, cursor: dict):
+        super().__init__(
+            f"job preempted at cursor {cursor}; resume state in "
+            f"{manifest_path} (relaunch the same JobSpec to resume)")
+        self.manifest_path = manifest_path
+        self.cursor = dict(cursor)
+        self.rc = RC_PREEMPTED
+
+
+def load_manifest(workdir: str) -> dict | None:
+    """The resume manifest in ``workdir``, or None (absent/unreadable
+    — a torn manifest write cannot happen by construction, but a
+    foreign file can)."""
+    path = os.path.join(workdir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(m, dict) and m.get("schema") == MANIFEST_SCHEMA:
+        return m
+    return None
+
+
+class JobContext:
+    """What a job payload gets: the persisted cursor/ledger + the stop
+    flag, all backed by the atomic manifest."""
+
+    def __init__(self, runtime: "JobRuntime", manifest: dict):
+        self._rt = runtime
+        self.spec = runtime.spec
+        self.workdir = runtime.spec.workdir
+        self.checkpoint_dir = os.path.join(self.workdir, "checkpoints")
+        self.manifest = manifest
+
+    # -- stop flag ---------------------------------------------------------
+    def stop_requested(self) -> bool:
+        return self._rt._stop.is_set()
+
+    def request_stop(self):
+        """Programmatic preemption (tests; cooperative schedulers)."""
+        self._rt._stop.set()
+
+    # -- checkpoints -------------------------------------------------------
+    def checkpoints(self, save_every: int | None = None):
+        from tpudl.train.checkpoint import CheckpointManager
+
+        return CheckpointManager(
+            self.checkpoint_dir,
+            save_every=save_every if save_every is not None
+            else self.spec.save_every)
+
+    # -- cursor ------------------------------------------------------------
+    @property
+    def cursor(self) -> dict:
+        return dict(self.manifest.get("cursor") or {})
+
+    def update_cursor(self, **fields):
+        cur = self.manifest.setdefault("cursor", {})
+        cur.update({k: int(v) for k, v in fields.items()})
+        self._rt._persist()
+
+    def set_bounds(self, **fields):
+        """Dataset/step bounds for the manifest audit
+        (tools/validate_job.py: cursor ≤ bounds)."""
+        b = self.manifest.setdefault("bounds", {})
+        b.update({k: int(v) for k, v in fields.items()})
+        self._rt._persist()
+
+    # -- trial ledger ------------------------------------------------------
+    def trials_done(self) -> set[int]:
+        return {int(k) for k in
+                (self.manifest.get("trials") or {}).get("done", {})}
+
+    def mark_trial_started(self, index: int):
+        t = self.manifest.setdefault(
+            "trials", {"done": {}, "in_flight": [], "pending": []})
+        if int(index) not in t["in_flight"]:
+            t["in_flight"].append(int(index))
+        if int(index) in t["pending"]:
+            t["pending"].remove(int(index))
+        self._rt._persist()
+
+    def mark_trial_done(self, index: int, **meta):
+        t = self.manifest.setdefault(
+            "trials", {"done": {}, "in_flight": [], "pending": []})
+        t["done"][str(int(index))] = {"ts": time.time(), **meta}
+        if int(index) in t["in_flight"]:
+            t["in_flight"].remove(int(index))
+        if int(index) in t["pending"]:
+            t["pending"].remove(int(index))
+        self._rt._persist()
+
+    def set_trials_pending(self, indices):
+        t = self.manifest.setdefault(
+            "trials", {"done": {}, "in_flight": [], "pending": []})
+        t["pending"] = [int(i) for i in indices
+                        if str(int(i)) not in t["done"]]
+        self._rt._persist()
+
+    # -- data-plane helpers ------------------------------------------------
+    def iter_batches(self, dataset, epochs: int):
+        """Resume-aware epoch iteration over a :class:`tpudl.data.
+        Dataset`: yields ``(epoch, batch_index, batch)`` starting at
+        the persisted cursor, advancing it after every yielded batch
+        (rework on preemption: ≤ 1 batch of data prep). With the
+        dataset's ``cache_dir`` set, batches prepared before the kill
+        replay from the shard cache — zero re-decodes past the
+        cursor."""
+        cur = self.cursor
+        e0, b0 = int(cur.get("epoch", 0)), int(cur.get("batch", 0))
+        nb = dataset.num_batches
+        self.set_bounds(epochs=epochs, batches_per_epoch=nb)
+        for epoch in range(e0, int(epochs)):
+            for b in range(b0 if epoch == e0 else 0, nb):
+                if self.stop_requested():
+                    self.update_cursor(epoch=epoch, batch=b)
+                    raise self._rt._preempted()
+                yield epoch, b, dataset.get_batch(b)
+                self.update_cursor(epoch=epoch, batch=b + 1)
+        self.update_cursor(epoch=int(epochs), batch=0)
+
+    def run_trials(self, items, trial_fn, *, scheduler=None, retry=None):
+        """Resume-aware trial sweep: already-done trials (per the
+        ledger) are skipped; fresh ones run on the
+        :class:`~tpudl.ml.hpo.TrialScheduler` and are marked done as
+        they complete. Yields ``(index, result)`` for FRESH trials only
+        (completed ones have no recreatable result object — their
+        artifacts are the caller's, keyed by index)."""
+        from tpudl.ml.hpo import TrialScheduler
+
+        items = list(items)
+        done = self.trials_done()
+        todo = [(i, it) for i, it in enumerate(items) if i not in done]
+        self.set_bounds(trials=len(items))
+        self.set_trials_pending([i for i, _ in todo])
+        if not todo:
+            return
+        mapping = [i for i, _ in todo]
+        sched = scheduler or TrialScheduler()
+
+        def wrapped(j, item, devs):
+            # in_flight marks trials that actually STARTED (here, in
+            # the scheduler's worker), not everything queued: a kill
+            # mid-sweep leaves a ledger an operator can read literally
+            self.mark_trial_started(mapping[j])
+            return trial_fn(mapping[j], item, devs)
+
+        for j, res in sched.run([it for _, it in todo], wrapped,
+                                retry=retry):
+            i = mapping[j]
+            self.mark_trial_done(i)
+            yield i, res
+            if self.stop_requested():
+                raise self._rt._preempted()
+
+
+class JobRuntime:
+    """Run a JobSpec with persistent resume state (module docstring)."""
+
+    def __init__(self, spec: JobSpec, *, install_signals: bool = True):
+        self.spec = spec
+        self._install_signals = bool(install_signals)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._manifest: dict | None = None
+        self._prev_sigterm = None
+
+    # -- manifest persistence ---------------------------------------------
+    def manifest_path(self) -> str:
+        return os.path.join(self.spec.workdir, MANIFEST_NAME)
+
+    def _persist(self):
+        with self._lock:
+            m = self._manifest
+            if m is None:
+                return
+            m["updated_ts"] = time.time()
+            tmp = self.manifest_path() + f".tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(m, f)
+                os.replace(tmp, self.manifest_path())
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def _begin(self) -> JobContext:
+        os.makedirs(self.spec.workdir, exist_ok=True)
+        prev = load_manifest(self.spec.workdir)
+        fp = self.spec.fingerprint()
+        if prev is not None and prev.get("fingerprint") != fp:
+            raise ValueError(
+                f"workdir {self.spec.workdir} holds resume state for a "
+                f"DIFFERENT job (manifest fingerprint "
+                f"{str(prev.get('fingerprint'))[:12]} != spec {fp[:12]}); "
+                "refusing to resume foreign state — use a fresh workdir")
+        m = prev or {
+            "schema": MANIFEST_SCHEMA, "version": MANIFEST_VERSION,
+            "fingerprint": fp, "kind": self.spec.kind,
+            "name": self.spec.name, "save_every": self.spec.save_every,
+            "created_ts": time.time(), "attempt": 0,
+            "cursor": {}, "bounds": {},
+            "trials": {"done": {}, "in_flight": [], "pending": []},
+            "checkpoint": {"dir": "checkpoints", "step": None},
+        }
+        m["attempt"] = int(m.get("attempt", 0)) + 1
+        m["status"] = "running"
+        m["pid"] = os.getpid()
+        self._manifest = m
+        self._persist()
+        try:
+            from tpudl.obs import flight as _flight
+
+            _flight.get_recorder().record_event(
+                "job.start", job_kind=self.spec.kind,
+                name=self.spec.name, fingerprint=fp[:12],
+                attempt=m["attempt"], resumed=prev is not None,
+                manifest=self.manifest_path())
+        except Exception:
+            pass
+        return JobContext(self, m)
+
+    # -- signals -----------------------------------------------------------
+    def _arm_sigterm(self):
+        if not self._install_signals:
+            return
+        try:
+            self._prev_sigterm = signal.getsignal(signal.SIGTERM)
+
+            def handler(signum, frame):
+                # graceful path: flag only — the run checkpoints at its
+                # next boundary and exits RC_PREEMPTED itself. NOT
+                # chained to the flight recorder's kill handler: this
+                # is a recovery event, and the recorder's own dump is
+                # written (with the manifest pointer) at that boundary.
+                # NOTHING else happens here: touching the recorder (or
+                # any lock) from signal context can deadlock against
+                # the interrupted frame — the flight module's own dump
+                # contract; the job.preempted breadcrumb is recorded at
+                # the boundary, on a normal thread.
+                self._stop.set()
+
+            signal.signal(signal.SIGTERM, handler)
+        except (ValueError, OSError):  # not the main thread
+            self._prev_sigterm = None
+
+    def _disarm_sigterm(self):
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigterm = None
+
+    # -- finalization ------------------------------------------------------
+    def _refresh_checkpoint_pointer(self):
+        m = self._manifest
+        try:
+            from tpudl.train.checkpoint import CheckpointManager
+
+            ckpt_dir = os.path.join(self.spec.workdir, "checkpoints")
+            if os.path.isdir(ckpt_dir):
+                step = CheckpointManager(
+                    ckpt_dir, save_every=self.spec.save_every
+                ).latest_step()
+                m["checkpoint"] = {"dir": "checkpoints", "step": step}
+        except Exception:
+            pass
+
+    def _preempted(self) -> JobPreempted:
+        """Finalize preempted state → the JobPreempted to raise."""
+        m = self._manifest
+        m["status"] = "preempted"
+        self._refresh_checkpoint_pointer()
+        self._persist()
+        try:
+            from tpudl.obs import flight as _flight
+
+            _flight.get_recorder().record_event(
+                "job.preempted", manifest=self.manifest_path(),
+                fingerprint=m.get("fingerprint", "")[:12],
+                cursor=json.dumps(m.get("cursor") or {}),
+                attempt=m.get("attempt"))
+            # the black box lands IN the workdir: `obs doctor <workdir>`
+            # then classifies this death as preempted_resumable (the
+            # dump carries the manifest pointer via the event above)
+            _flight.dump(
+                reason="preempted_resumable",
+                path=os.path.join(self.spec.workdir,
+                                  f"tpudl-dump-{os.getpid()}.json.gz"))
+        except Exception:
+            pass
+        return JobPreempted(self.manifest_path(), m.get("cursor") or {})
+
+    # -- entry points ------------------------------------------------------
+    def run(self, fn, *, exit_on_preempt: bool = False):
+        """Execute ``fn(ctx)`` under the resume contract. On preemption:
+        manifest + checkpoint persisted, flight dump written, then
+        ``JobPreempted`` raised — or, with ``exit_on_preempt`` (the
+        process-entry mode the relaunch contract wants), ``SystemExit
+        (RC_PREEMPTED)``."""
+        ctx = self._begin()
+        self._arm_sigterm()
+        try:
+            from tpudl.train.runner import Preempted as _TrainPreempted
+
+            try:
+                result = fn(ctx)
+            except JobPreempted:
+                raise
+            except _TrainPreempted as p:
+                # Trainer.fit saw the stop flag and already force-saved
+                # at p.step; fold that into the unified cursor
+                ctx.update_cursor(step=p.step)
+                raise self._preempted() from p
+            m = self._manifest
+            m["status"] = "done"
+            self._refresh_checkpoint_pointer()
+            self._persist()
+            try:
+                from tpudl.obs import flight as _flight
+
+                _flight.get_recorder().record_event(
+                    "job.done", manifest=self.manifest_path())
+            except Exception:
+                pass
+            return result
+        except JobPreempted as jp:
+            if exit_on_preempt:
+                raise SystemExit(jp.rc) from jp
+            raise
+        except (Exception, KeyboardInterrupt) as e:
+            m = self._manifest
+            m["status"] = "failed"
+            m["error"] = f"{type(e).__name__}: {e}"[:500]
+            self._persist()
+            try:
+                from tpudl.obs import flight as _flight
+
+                _flight.record_error("job.failed", e,
+                                     manifest=self.manifest_path())
+            except Exception:
+                pass
+            raise
+        finally:
+            self._disarm_sigterm()
+
+    def run_fit(self, trainer, params, data_fn, steps: int, *,
+                opt_state=None, exit_on_preempt: bool = False):
+        """The Trainer adapter: ``trainer`` (a :class:`tpudl.train.
+        Trainer`) is pointed at the job's checkpoint dir and driven
+        with the runtime's stop flag; the data cursor IS the step
+        counter (``data_fn`` is index-addressable by the Trainer
+        contract), so one unified resume state covers model + data."""
+
+        def payload(ctx):
+            trainer.checkpoint_dir = ctx.checkpoint_dir
+            trainer.save_every = self.spec.save_every
+            ctx.set_bounds(steps=int(steps))
+            out = trainer.fit(params, data_fn, int(steps),
+                              opt_state=opt_state,
+                              stop=ctx.stop_requested)
+            ctx.update_cursor(step=int(steps))
+            return out
+
+        return self.run(payload, exit_on_preempt=exit_on_preempt)

@@ -1,0 +1,112 @@
+"""JobSpec — the identity of a resumable run.
+
+A job is "the same job" across process restarts when its spec
+fingerprints identically: kind (fit / estimator_fit / featurize / hpo)
+plus the content identity of everything that determines its result —
+the Frame/Dataset fingerprint (PR-4 machinery: paths + sizes + mtimes,
+codec, batch geometry), the model token, and the knob dict. A
+re-launched ``JobRuntime`` refuses to resume a workdir whose manifest
+was written by a DIFFERENT fingerprint: resuming someone else's
+checkpoint into your model is corruption, not recovery.
+
+Specs are plain JSON-able data (``to_dict``/``from_dict``/``to_json``)
+so a scheduler can ship one to a fresh process — the kill-mid-epoch
+acceptance test does exactly that.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+__all__ = ["JobSpec", "fingerprint_material"]
+
+KINDS = ("fit", "estimator_fit", "featurize", "hpo", "custom")
+
+
+def _canon(value):
+    """JSON-canonical form of one material value (dicts sorted,
+    callables by their cache token — same contract as the shard
+    cache's key material)."""
+    if isinstance(value, dict):
+        return {str(k): _canon(v) for k, v in sorted(value.items())}
+    if isinstance(value, (list, tuple)):
+        return [_canon(v) for v in value]
+    if callable(value):
+        tok = getattr(value, "cache_token", None)
+        if tok:
+            return str(tok)
+        return "|".join((getattr(value, "__module__", "?"),
+                         getattr(value, "__qualname__", repr(value))))
+    if isinstance(value, (str, int, float, bool, type(None))):
+        return value
+    return repr(value)
+
+
+def fingerprint_material(*, frame=None, dataset=None, input_cols=None,
+                         model=None, knobs=None, **extra) -> dict:
+    """Build a spec's material dict from the pipeline objects: the
+    Frame answers with its content ``fingerprint`` (lazy columns probe
+    paths+sizes+mtimes — no decode), a Dataset contributes its cache
+    identity, the model a token/path, ``knobs`` any hyperparameter
+    dict. Everything lands as JSON-able values."""
+    mat: dict = {}
+    if frame is not None:
+        mat["frame"] = frame.fingerprint(list(input_cols)
+                                         if input_cols else None)
+    if dataset is not None:
+        cache = getattr(dataset, "cache", None)
+        mat["dataset"] = {
+            "rows": len(dataset), "batches": dataset.num_batches,
+            "cache_key": getattr(cache, "key", None)}
+    if model is not None:
+        mat["model"] = _canon(model)
+    if knobs is not None:
+        mat["knobs"] = _canon(knobs)
+    for k, v in extra.items():
+        mat[k] = _canon(v)
+    return mat
+
+
+class JobSpec:
+    """Identity + workdir + resume knobs of one resumable job."""
+
+    def __init__(self, kind: str, workdir: str, *, material: dict | None
+                 = None, save_every: int = 100, name: str | None = None):
+        if kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+        self.kind = str(kind)
+        self.workdir = os.path.abspath(str(workdir))
+        self.material = _canon(material or {})
+        self.save_every = int(save_every)
+        self.name = str(name) if name else self.kind
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha1()
+        h.update(json.dumps({"kind": self.kind, "material": self.material},
+                            sort_keys=True).encode())
+        return h.hexdigest()
+
+    # -- serialization -----------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "workdir": self.workdir,
+                "material": self.material, "save_every": self.save_every,
+                "name": self.name}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobSpec":
+        return cls(d["kind"], d["workdir"], material=d.get("material"),
+                   save_every=int(d.get("save_every", 100)),
+                   name=d.get("name"))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "JobSpec":
+        return cls.from_dict(json.loads(s))
+
+    def __repr__(self) -> str:
+        return (f"JobSpec({self.kind!r}, {self.workdir!r}, "
+                f"fingerprint={self.fingerprint()[:12]})")

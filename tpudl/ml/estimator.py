@@ -78,7 +78,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                  imageLoader=None, modelFile=None, kerasOptimizer=None,
                  kerasLoss=None, kerasFitParams=None, mesh=None,
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
-                 wireCodec=None, cacheDir=None):
+                 wireCodec=None, cacheDir=None, trialRetryPolicy=None):
         super().__init__()
         self._setDefault(kerasFitParams={"batch_size": 32, "epochs": 1,
                                          "verbose": 0})
@@ -96,6 +96,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         # ship 4× fewer host->device bytes.
         self.wireCodec = wireCodec
         self.cacheDir = cacheDir
+        # per-trial retry (tpudl.jobs.RetryPolicy): a TRANSIENT trial
+        # failure re-attempts on its slice instead of failing the whole
+        # fitMultiple sweep (TrialScheduler.run's retry= contract; None
+        # falls back to the TPUDL_HPO_TRIAL_ATTEMPTS env opt-in)
+        self.trialRetryPolicy = trialRetryPolicy
         self._save_lock = threading.Lock()  # shared keras write-back
         # one compiled train step per (ingested graph, loss, optimizer),
         # shared across every trial (learning rate is dynamic in opt_state,
@@ -107,7 +112,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         kwargs = dict(self._input_kwargs)
         kwargs.pop("mesh", None)
         for k in ("prefetchDepth", "prepareWorkers", "fuseSteps",
-                  "wireCodec", "cacheDir"):
+                  "wireCodec", "cacheDir", "trialRetryPolicy"):
             kwargs.pop(k, None)
         self._set(**kwargs)
 
@@ -420,7 +425,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 return confs[i]._make_transformer(path)
 
             try:
-                yield from sched.run(paramMaps, trial)
+                yield from sched.run(paramMaps, trial,
+                                     retry=self.trialRetryPolicy)
             finally:
                 # entries are keyed by this call's gin and can never be
                 # re-hit afterwards; dropping them releases the compiled

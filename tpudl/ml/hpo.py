@@ -70,11 +70,26 @@ class TrialScheduler:
                          else jax.devices())
         self._max_parallel = max_parallel
 
-    def run(self, items: Sequence, trial_fn: Callable,
-            ) -> Iterator[tuple[int, object]]:
+    def run(self, items: Sequence, trial_fn: Callable, *,
+            retry=None) -> Iterator[tuple[int, object]]:
+        """``retry`` (a :class:`tpudl.jobs.RetryPolicy`) re-attempts a
+        trial whose failure classifies as TRANSIENT (flaky IO, a
+        backend hiccup) on its own slice before the sweep fails; every
+        re-attempt increments ``hpo.trial_retries`` and lands in the
+        flight recorder's error ring, so ``obs top``/``doctor`` show
+        attempt counts. Default (or ``TPUDL_HPO_TRIAL_ATTEMPTS`` unset/
+        1): first failure propagates, exactly as before. Fatal
+        failures (preemption) are never retried."""
         items = list(items)
         if not items:
             return
+        if retry is None:
+            from tpudl.jobs.retry import RetryPolicy, _env_int
+
+            attempts = _env_int("TPUDL_HPO_TRIAL_ATTEMPTS", 1)
+            if attempts > 1:
+                retry = RetryPolicy(max_attempts=attempts,
+                                    backoff_s=0.05, max_backoff_s=5.0)
         slices = device_slices(len(items), self._devices)
         if self._max_parallel:
             slices = slices[: self._max_parallel]
@@ -98,7 +113,14 @@ class TrialScheduler:
                                              of=len(items)), \
                         _obs_tracer.span("hpo.trial", index=i,
                                          slice_width=len(slices[s])):
-                    out = i, trial_fn(i, item, slices[s])
+                    if retry is not None:
+                        out = i, retry.call(
+                            trial_fn, i, item, slices[s],
+                            kind="hpo.trial",
+                            on_retry=lambda e, a: _obs_metrics.counter(
+                                "hpo.trial_retries").inc())
+                    else:
+                        out = i, trial_fn(i, item, slices[s])
                 _obs_metrics.counter("hpo.trials_completed").inc()
                 return out
             except BaseException as e:

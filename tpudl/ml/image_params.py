@@ -88,6 +88,11 @@ def load_uri_batch(loader, uris) -> np.ndarray:
         return out
     arrays = []
     for uri in uris:
+        # deliberately NOT wrapped in the io retry policy: loader(uri)
+        # fuses read+decode, and PIL decode failures are OSError-shaped
+        # — a retry here would re-decode bad bytes with backoff. The
+        # shipped loaders retry their raw READS internally
+        # (createNativeImageLoader._read_all, LazyFileColumn._read_raw)
         arr = np.asarray(loader(uri))
         if arr.ndim == 2:
             arr = arr[:, :, None]

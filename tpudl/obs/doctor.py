@@ -4,6 +4,11 @@ The offline half of failure forensics (OBSERVABILITY.md): given one
 ``tpudl-dump-*.json.gz`` (or a directory of them from a multi-host
 gang), merge per-host evidence and CLASSIFY the failure:
 
+- ``preempted_resumable`` — the job runtime (tpudl.jobs) caught the
+  SIGTERM, checkpointed, and exited RC_PREEMPTED: the dump carries a
+  resume-manifest pointer — relaunch the same JobSpec to resume.
+  Checked FIRST: stall/storm history must not bury the one actionable
+  fact;
 - ``infeed_stall`` — the watchdog flagged a frozen input-side stage
   (prepare/h2d/infeed), or the pipeline report died with the consumer
   parked in ``infeed_wait``: the input pipeline stopped delivering;
@@ -13,7 +18,8 @@ gang), merge per-host evidence and CLASSIFY the failure:
 - ``dispatch_slowdown`` — a stall (or dominant stage share) in
   ``dispatch``: the device/backend stopped answering or slowed;
 - ``clean_external_kill`` — a SIGTERM/SIGQUIT dump with no stall and
-  no error storm: the driver killed a healthy run (the rc=124 class);
+  no error storm, and NO resume state: the driver killed a healthy
+  run (the rc=124 class);
 - ``exception`` — an unhandled exception dump: the error is right
   there;
 - ``unclassified`` — evidence exists but matches no rule (everything
@@ -215,8 +221,50 @@ def classify(merged: dict) -> dict:
             f"(attempt {restarts[-1].get('attempt')}, "
             f"step {restarts[-1].get('step')})")
 
-    # 1. decode-error storm: the strongest signal — bad data starves or
-    #    stalls everything downstream of it
+    # 1. the job runtime turned the kill into a recovery event: the
+    #    dump says so (reason) or carries the job.preempted breadcrumb
+    #    with the resume-manifest pointer. FIRST rule: the runtime
+    #    literally checkpointed and exited rc 75 — stall/storm evidence
+    #    from earlier in the run's history must not bury the one
+    #    actionable fact (relaunch the spec); it still rides along in
+    #    the evidence list. Checked across ALL hosts — in a gang, any
+    #    member that persisted resume state makes the death resumable
+    preempt_ev = None
+    for d in sorted(hosts.values(), key=lambda d: d.get("ts", 0),
+                    reverse=True):
+        for ev in reversed(d.get("events") or []):
+            if ev.get("kind") == "job.preempted":
+                preempt_ev = ev
+                break
+        if preempt_ev is not None:
+            break
+    if reason == "preempted_resumable" or preempt_ev is not None:
+        manifest = (preempt_ev or {}).get("manifest")
+        if stalls:
+            last = stalls[-1]
+            evidence.append(
+                f"history: watchdog flagged {len(stalls)} stall(s); "
+                f"last: {last.get('name')} frozen {last.get('age_s')}s "
+                f"in stage {_stall_stage(last) or 'unknown'!r}")
+        if bad:
+            evidence.append(f"history: {decode_errs:.0f} decode errors "
+                            f"+ {corrupt:.0f} corrupt shards over "
+                            f"{reads:.0f} read attempts")
+        evidence.insert(0, (
+            "the job runtime checkpointed and exited on the kill "
+            "(rc 75, preempted-resumable); resume state: "
+            f"{manifest or 'see job-manifest.json in the job workdir'}"
+            + (f", cursor {preempt_ev.get('cursor')}"
+               if preempt_ev and preempt_ev.get("cursor") else "")))
+        evidence.append("relaunch the SAME JobSpec to resume with "
+                        "bounded rework (JOBS.md)")
+        return {"classification": "preempted_resumable",
+                "suspect_stage": None, "suspect_host": None,
+                "resume_manifest": manifest,
+                "evidence": evidence, "stage_rates": rates}
+
+    # 2. decode-error storm: the strongest failure signal — bad data
+    #    starves or stalls everything downstream of it
     if bad >= STORM_MIN_EVENTS and bad >= STORM_MIN_FRAC * max(reads, 1.0):
         evidence.insert(0, (
             f"{decode_errs:.0f} decode errors + {corrupt:.0f} corrupt "
@@ -228,7 +276,7 @@ def classify(merged: dict) -> dict:
                 "suspect_host": suspect_host,
                 "evidence": evidence, "stage_rates": rates}
 
-    # 2/3. watchdog stalls: which side froze?
+    # 3/4. watchdog stalls: which side froze?
     if stalls:
         last = stalls[-1]
         stage = _stall_stage(last)
@@ -257,7 +305,7 @@ def classify(merged: dict) -> dict:
                 "suspect_host": last.get("host"),
                 "evidence": evidence, "stage_rates": rates}
 
-    # 4. no stall, no storm, external signal: a healthy run was killed
+    # 5. no stall, no storm, external signal: a healthy run was killed
     if reason.startswith("signal"):
         evidence.insert(0, (
             f"dump reason {reason!r} with no stalls and no error "
@@ -275,7 +323,7 @@ def classify(merged: dict) -> dict:
                 "suspect_stage": None, "suspect_host": None,
                 "evidence": evidence, "stage_rates": rates}
 
-    # 5. unhandled exception: the error explains itself
+    # 6. unhandled exception: the error explains itself
     err = newest.get("error")
     if reason == "exception" and err:
         evidence.insert(0, f"unhandled {err.get('type')}: "
@@ -284,7 +332,7 @@ def classify(merged: dict) -> dict:
                 "suspect_stage": None, "suspect_host": None,
                 "evidence": evidence, "stage_rates": rates}
 
-    # 6. a slow-but-alive dispatch dominating the last report
+    # 7. a slow-but-alive dispatch dominating the last report
     if rates:
         dominant = max(rates.items(), key=lambda kv: kv[1]["seconds"])
         total = sum(v["seconds"] for v in rates.values()) or 1.0

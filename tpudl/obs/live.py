@@ -52,7 +52,7 @@ STATUS_PREFIX = "tpudl-status-"
 
 _METRIC_PREFIXES = ("train.", "hpo.", "udf.", "estimator.",
                     "obs.watchdog.", "obs.roofline.",
-                    "frame.map_batches.")
+                    "frame.map_batches.", "retry.")
 
 
 def _status_dir() -> str | None:

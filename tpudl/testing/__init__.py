@@ -1,0 +1,13 @@
+"""Test-support machinery that ships with the product.
+
+:mod:`tpudl.testing.faults` is the deterministic fault-injection
+harness the preemption/robustness suite (tests/test_jobs.py) drives:
+production code exposes named fault points (``faults.fire("...")`` —
+a no-op unless a plan is armed), and a :class:`FaultPlan` decides,
+deterministically, which firing dies and how. It lives in the package
+(not tests/) because the kill-mid-epoch acceptance tests arm plans in
+SUBPROCESSES via ``TPUDL_FAULT_PLAN`` — the harness must be importable
+wherever tpudl is.
+"""
+
+from tpudl.testing.faults import FaultPlan, arm, disarm, fire  # noqa: F401

@@ -1,0 +1,274 @@
+"""Deterministic fault injection — the harness that PROVES recovery.
+
+The robustness claims of the job runtime (JOBS.md) are only claims
+until a test can kill, corrupt, and starve the pipeline on demand and
+watch it recover. This module is that demand side:
+
+- production code exposes **fault points**: ``faults.fire("frame.
+  dispatch", index=i)`` at the top of each executor stage, per train
+  step, per shard-cache read, per file read. Unarmed (the default,
+  always in production), ``fire`` is a global ``None``-check — the
+  executor overhead guard in tests/test_obs_flight.py already pins the
+  whole observer stack at <5%, and this is far cheaper than a metric
+  increment;
+- a :class:`FaultPlan` is a list of RULES, each naming a point, a
+  deterministic trigger (the Nth call, the first K calls, or a ctx
+  match like ``step == 13``), and an action:
+
+  - ``raise`` — raise a chosen exception type (stage faults,
+    transient IO errors with recovery-after-K via ``first_calls``);
+  - ``sigterm`` — SIGTERM-to-self (the preemption kill, delivered at
+    an exact step instead of a racy external timer);
+  - ``corrupt`` — flip one byte of the file named by the firing's
+    ``path`` ctx (shard/checkpoint bit-rot on the read path).
+
+Plans arm process-locally (``with plan.armed(): ...``) or across a
+process boundary via ``TPUDL_FAULT_PLAN`` (JSON; the kill-mid-epoch
+subprocess tests use this — ``install_from_env()`` in the child).
+Every triggered fault is appended to ``plan.fired`` and filed into the
+flight recorder's error ring (kind ``fault.injected``), so the forensic
+trail of an injected death looks exactly like a real one.
+"""
+
+from __future__ import annotations
+
+import builtins
+import json
+import os
+import signal
+import threading
+
+__all__ = ["FaultPlan", "FaultInjected", "arm", "disarm", "fire",
+           "install_from_env", "PLAN_ENV"]
+
+PLAN_ENV = "TPUDL_FAULT_PLAN"
+
+_PLAN: "FaultPlan | None" = None
+_ARM_LOCK = threading.Lock()
+
+
+class FaultInjected(RuntimeError):
+    """Default exception for ``raise`` rules that don't name one."""
+
+
+def _resolve_exc(name: str | None):
+    """Exception class by builtin name (allowlist: must actually be an
+    exception type); anything unknown falls back to FaultInjected so a
+    typo'd plan still injects a failure instead of silently passing."""
+    if not name:
+        return FaultInjected
+    cls = getattr(builtins, str(name), None)
+    if isinstance(cls, type) and issubclass(cls, BaseException) \
+            and not issubclass(cls, (SystemExit, KeyboardInterrupt)):
+        return cls
+    return FaultInjected
+
+
+class _Rule:
+    """One deterministic fault rule (see module docstring)."""
+
+    def __init__(self, spec: dict):
+        self.point = str(spec["point"])
+        self.action = str(spec.get("action", "raise"))
+        if self.action not in ("raise", "sigterm", "corrupt", "unlink"):
+            raise ValueError(f"unknown fault action {self.action!r}")
+        # triggers — all optional, all must match when present:
+        self.at_call = spec.get("at_call")        # exactly the Nth call
+        self.first_calls = spec.get("first_calls")  # calls 1..K
+        self.when = dict(spec.get("when") or {})  # ctx equality
+        self.exc = spec.get("exc")
+        self.message = spec.get("message") or (
+            f"injected fault at {self.point}")
+        self.calls = 0       # firings seen at this point
+        self.triggered = 0   # firings that took the action
+
+    def matches(self, ctx: dict) -> bool:
+        if self.at_call is not None and self.calls != int(self.at_call):
+            return False
+        if self.first_calls is not None \
+                and self.calls > int(self.first_calls):
+            return False
+        for k, v in self.when.items():
+            if ctx.get(k) != v:
+                return False
+        return True
+
+    def to_dict(self) -> dict:
+        d = {"point": self.point, "action": self.action}
+        for k in ("at_call", "first_calls", "exc", "message"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        if self.when:
+            d["when"] = self.when
+        return d
+
+
+class FaultPlan:
+    """A deterministic set of fault rules, armed process-globally."""
+
+    def __init__(self, rules):
+        self._lock = threading.Lock()
+        self.rules = [r if isinstance(r, _Rule) else _Rule(dict(r))
+                      for r in rules]
+        self.fired: list[dict] = []  # every TRIGGERED fault, for asserts
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def kill_at_step(cls, step: int, point: str = "train.step",
+                     ) -> "FaultPlan":
+        """SIGTERM-to-self the Nth time ``point`` fires with
+        ``step == N`` — the deterministic preemption kill."""
+        return cls([{"point": point, "action": "sigterm",
+                     "when": {"step": int(step)}}])
+
+    @classmethod
+    def raise_in_stage(cls, stage: str, at_call: int = 1,
+                       exc: str | None = None) -> "FaultPlan":
+        """Raise inside one executor stage (prepare/h2d/dispatch/d2h)
+        on its ``at_call``-th entry."""
+        return cls([{"point": f"frame.{stage}", "action": "raise",
+                     "at_call": int(at_call), "exc": exc}])
+
+    @classmethod
+    def transient_io(cls, first_calls: int, point: str = "io.read",
+                     exc: str = "OSError") -> "FaultPlan":
+        """Fail the first K firings of an IO point, then recover — the
+        retry-policy acceptance shape (recovery-after-K)."""
+        return cls([{"point": point, "action": "raise",
+                     "first_calls": int(first_calls), "exc": exc,
+                     "message": f"injected transient IO error "
+                                f"(first {first_calls} calls)"}])
+
+    @classmethod
+    def corrupt_on_read(cls, point: str = "shards.read",
+                        at_call: int = 1) -> "FaultPlan":
+        """Bit-flip the file a read point is about to open (the firing
+        must pass ``path=`` ctx)."""
+        return cls([{"point": point, "action": "corrupt",
+                     "at_call": int(at_call)}])
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        raw = os.environ.get(PLAN_ENV)
+        if not raw:
+            return None
+        spec = json.loads(raw)
+        if isinstance(spec, dict):
+            spec = [spec]
+        return cls(spec)
+
+    def to_env(self) -> str:
+        """JSON for ``TPUDL_FAULT_PLAN`` (subprocess arming)."""
+        return json.dumps([r.to_dict() for r in self.rules])
+
+    # -- the hot hook ------------------------------------------------------
+    def fire(self, point: str, **ctx):
+        matched = None
+        with self._lock:
+            for rule in self.rules:
+                if rule.point != point:
+                    continue
+                rule.calls += 1
+                if rule.matches(ctx):
+                    rule.triggered += 1
+                    matched = rule
+                    self.fired.append(
+                        {"point": point, "action": rule.action,
+                         "call": rule.calls, **ctx})
+                    break
+        if matched is None:
+            return
+        try:  # forensics: an injected death must leave the same trail
+            from tpudl.obs import flight as _flight
+
+            _flight.record_error(
+                "fault.injected", matched.message, point=point,
+                action=matched.action, call=matched.calls,
+                **{k: v for k, v in ctx.items()
+                   if isinstance(v, (int, float, str, bool, type(None)))})
+        except Exception:
+            pass
+        if matched.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return  # the handler decides what dies; the firing returns
+        if matched.action == "corrupt":
+            path = ctx.get("path")
+            if path:
+                _flip_one_byte(str(path))
+            return
+        if matched.action == "unlink":
+            # the concurrent-eviction race, made deterministic: delete
+            # the file between the caller's manifest read and its open
+            path = ctx.get("path")
+            if path:
+                try:
+                    os.unlink(str(path))
+                except OSError:
+                    pass
+            return
+        raise _resolve_exc(matched.exc)(
+            f"{matched.message} [{point} call {matched.calls}]")
+
+    # -- arming ------------------------------------------------------------
+    def armed(self):
+        return _Armed(self)
+
+
+class _Armed:
+    def __init__(self, plan: FaultPlan):
+        self._plan = plan
+
+    def __enter__(self):
+        arm(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc):
+        disarm()
+
+
+def _flip_one_byte(path: str):
+    """In-place single-byte flip at mid-file (deliberately NOT atomic —
+    this IS the bit-rot being simulated)."""
+    try:
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        at = size // 2
+        with open(path, "r+b") as f:
+            f.seek(at)
+            b = f.read(1)
+            f.seek(at)
+            f.write(bytes([b[0] ^ 0xFF]))
+    except OSError:
+        pass
+
+
+def arm(plan: FaultPlan):
+    global _PLAN
+    with _ARM_LOCK:
+        _PLAN = plan
+    return plan
+
+
+def disarm():
+    global _PLAN
+    with _ARM_LOCK:
+        _PLAN = None
+
+
+def install_from_env() -> FaultPlan | None:
+    """Arm the ``TPUDL_FAULT_PLAN`` plan, if any (subprocess entry)."""
+    plan = FaultPlan.from_env()
+    if plan is not None:
+        arm(plan)
+    return plan
+
+
+def fire(point: str, **ctx):
+    """The production-side hook: a no-op global check unless a plan is
+    armed (never add work on this line — it sits on executor and train
+    hot paths)."""
+    plan = _PLAN
+    if plan is not None:
+        plan.fire(point, **ctx)

@@ -3,11 +3,14 @@
 HorovodRunner's MPI+NCCL contract re-owned as SPMD over the jax mesh:
 see :mod:`tpudl.train.runner` (Runner/Trainer), :mod:`tpudl.train.step`
 (the allreduce-equivalent jitted step), :mod:`tpudl.train.checkpoint`
-(orbax checkpoint/resume — first-class, unlike the reference).
+(atomic checksummed checkpoint/resume — first-class, unlike the
+reference). ``Preempted``/``RestartsExhausted`` are the typed edges the
+job runtime (tpudl.jobs, JOBS.md) builds its preemption contract on.
 """
 
 from tpudl.train.checkpoint import CheckpointManager
-from tpudl.train.runner import HorovodRunner, TrainContext, Trainer
+from tpudl.train.runner import (HorovodRunner, Preempted,
+                                RestartsExhausted, TrainContext, Trainer)
 from tpudl.train.step import (make_eval_step, make_train_step,
                               with_compute_dtype)
 
@@ -16,6 +19,8 @@ __all__ = [
     "TrainContext",
     "Trainer",
     "CheckpointManager",
+    "Preempted",
+    "RestartsExhausted",
     "make_train_step",
     "make_eval_step",
     "with_compute_dtype",

@@ -31,16 +31,61 @@ import numpy as np
 
 from tpudl import distributed as D
 from tpudl import mesh as M
+from tpudl.jobs.retry import RetryPolicy, is_fatal
 from tpudl.obs import flight as _obs_flight
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
 from tpudl.obs import watchdog as _obs_watchdog
+from tpudl.testing import faults as _faults
 from tpudl.train.checkpoint import CheckpointManager
 from tpudl.train.step import make_train_step
 
-__all__ = ["HorovodRunner", "TrainContext", "Trainer"]
+__all__ = ["HorovodRunner", "TrainContext", "Trainer", "Preempted",
+           "RestartsExhausted"]
 
 log = logging.getLogger("tpudl.train")
+
+
+class Preempted(Exception):
+    """Cooperative-stop signal: ``Trainer.fit(stop=...)`` saw the stop
+    flag, force-saved a checkpoint at ``step`` and unwound. Marked
+    ``tpudl_fatal`` so NO retry layer (gang restart, RetryPolicy, trial
+    retry) fights the preemption — the job runtime (tpudl.jobs) catches
+    it and turns it into an orderly preempted-resumable exit."""
+
+    tpudl_fatal = True
+
+    def __init__(self, step: int, saved: bool = True):
+        super().__init__(f"preempted at step {step}"
+                         + ("" if saved else " (no checkpoint dir — "
+                            "state NOT saved)"))
+        self.step = int(step)
+        self.saved = bool(saved)
+
+
+class RestartsExhausted(RuntimeError):
+    """The gang-restart budget ran out. Carries the LAST cause (also
+    chained as ``__cause__``) so the terminal error names why the gang
+    kept dying, not just that it did. Subclasses RuntimeError — and
+    embeds the cause's message — for compatibility with callers that
+    matched the previously re-raised original."""
+
+    def __init__(self, attempts: int, last_cause: BaseException):
+        super().__init__(
+            f"gang restart budget exhausted after {attempts} attempt(s); "
+            f"last cause: {type(last_cause).__name__}: {last_cause}")
+        self.attempts = int(attempts)
+        self.last_cause = last_cause
+
+
+def _restart_backoff_base_s() -> float:
+    import os
+
+    try:
+        return float(os.environ.get("TPUDL_TRAIN_RESTART_BACKOFF_S",
+                                    "") or 0.1)
+    except ValueError:
+        return 0.1
 
 
 class TrainContext:
@@ -86,12 +131,21 @@ class HorovodRunner:
 
     def __init__(self, np: int = -1, *, checkpoint_dir: str | None = None,
                  save_every: int = 100, max_restarts: int = 0,
-                 devices=None):
+                 devices=None, retry_policy: RetryPolicy | None = None):
         self._np = int(np)
         self.checkpoint_dir = checkpoint_dir
         self.save_every = save_every
         self.max_restarts = int(max_restarts)
         self._devices = devices
+        # the shared RetryPolicy governs restart PACING + classification
+        # (max_restarts stays the budget): exponential backoff + jitter
+        # between re-launches replaces the old immediate unbounded-rate
+        # re-spawn — a gang dying in a tight loop no longer hammers the
+        # backend while it is down (TPUDL_TRAIN_RESTART_BACKOFF_S base)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=self.max_restarts + 1,
+            backoff_s=_restart_backoff_base_s(), max_backoff_s=30.0,
+            transient="all")
 
     def _build_mesh(self):
         devs = list(self._devices) if self._devices else jax.devices()
@@ -117,6 +171,11 @@ class HorovodRunner:
                     with M.use_mesh(mesh):
                         return main(ctx, **kwargs)
             except Exception as e:
+                if is_fatal(e) or not self.retry_policy.is_transient(e):
+                    # a Preempted unwind (or a classified-permanent
+                    # failure) is an orderly stop, not a gang death:
+                    # restarting would fight the scheduler/caller
+                    raise
                 attempt += 1
                 # the step the gang died at (train.last_step gauge, set
                 # by Trainer.fit's finally) + the triggering exception
@@ -131,13 +190,23 @@ class HorovodRunner:
                     _obs_flight.record_error(
                         "train.exhausted", e, attempts=attempt,
                         max_restarts=self.max_restarts, step=last_step)
-                    raise
+                    raise RestartsExhausted(attempt, e) from e
                 # restart count is a first-class metric (a silently
-                # restarting gang looks healthy in logs-only setups)
+                # restarting gang looks healthy in logs-only setups);
+                # pacing via the shared policy: exponential backoff +
+                # jitter, published so a backing-off gang is visible
                 _obs_metrics.counter("train.restarts").inc()
+                self.retry_policy.record("train.restart", e,
+                                         attempt=attempt)
+                delay = self.retry_policy.backoff_s(attempt)
+                _obs_metrics.histogram(
+                    "train.restart_backoff_s").observe(delay)
                 log.exception(
                     "train_fn failed; gang restart %d/%d from last "
-                    "checkpoint", attempt, self.max_restarts)
+                    "checkpoint in %.2fs", attempt, self.max_restarts,
+                    delay)
+                if delay > 0:
+                    time.sleep(delay)
 
 
 class Trainer:
@@ -175,9 +244,19 @@ class Trainer:
         self._step_fn = make_train_step(loss_fn, optimizer, mesh,
                                         param_shardings=param_shardings)
 
-    def fit(self, params, data_fn, steps: int, *, opt_state=None):
+    def fit(self, params, data_fn, steps: int, *, opt_state=None,
+            stop=None):
         """Train for ``steps`` total steps (resuming included). Returns
-        (params, opt_state, history)."""
+        (params, opt_state, history).
+
+        ``stop`` (optional zero-arg callable → bool) is the cooperative
+        preemption check, polled at every step boundary: when it turns
+        truthy the trainer force-saves a checkpoint AT THE CURRENT STEP
+        (when a ``checkpoint_dir`` is configured) and raises
+        :class:`Preempted` — the checkpoint-then-exit half of the job
+        runtime's SIGTERM contract (JOBS.md), with resume rework bounded
+        at zero steps on the graceful path (≤ ``save_every`` when the
+        save itself is lost)."""
         self.history = []  # per-fit; stale entries would misreport results
 
         # own the buffers: the step donates params/opt_state, and device_put
@@ -338,10 +417,26 @@ class Trainer:
                                      start=start)
         try:
             for step in range(start, steps):
+                if stop is not None and stop():
+                    # checkpoint-then-exit: the state BEFORE this step
+                    # is saved at `step` (steps 0..step-1 completed), so
+                    # an identical relaunch resumes with zero re-work
+                    if mgr is not None:
+                        t_ck = time.perf_counter()
+                        mgr.save(step, {"params": params,
+                                        "opt_state": opt_state,
+                                        "step": np.asarray(step, np.int64)},
+                                 force=True)
+                        ckpt_hist.observe(time.perf_counter() - t_ck)
+                    raise Preempted(step, saved=mgr is not None)
                 # step + examples ride the beat: the live status plane
                 # (obs top) shows training progress from the heartbeat
                 # info without a second instrumentation channel
                 hb.beat(step=step, examples=examples)
+                # fault point for the preemption suite: a FaultPlan can
+                # SIGTERM-to-self or raise at an exact step (unarmed:
+                # one global None-check)
+                _faults.fire("train.step", step=step)
                 t_step = time.perf_counter()
                 batch = data_fn(step)
                 if not isinstance(batch, tuple):
