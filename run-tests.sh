@@ -5,6 +5,9 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "== tpudl-check (AST invariant linter, ANALYSIS.md) =="
+python -m tools.tpudl_check tpudl tools bench.py
+
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 

@@ -102,7 +102,12 @@ def test_summary_carries_judged_keys(bench, full_record):
         full_record["wire_bound_images_per_sec"]
     assert s["mfu_device"] == \
         full_record["device_profile"]["mfu_device"]
-    assert s["streaming_trials"]  # per-trial evidence rides along
+    # per-trial evidence rides along, attributed per arm (ADVICE.md:
+    # a merged list loses which arm each trial came from)
+    assert s["streaming_prefetch_trials"] == \
+        full_record["featurize_streaming"]["trials"]
+    assert s["streaming_serial_trials"] == \
+        full_record["featurize_streaming"]["serial_trials"]
     # sub-bench scalars present (field-name drift would break these)
     assert s["horovod_resnet50"] == \
         full_record["horovod_resnet50"]["step_per_sec"]

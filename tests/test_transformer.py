@@ -332,6 +332,10 @@ class TestKVCacheDecode:
                         temperature=0.5)
         with pytest.raises(ValueError, match="max_new"):
             lm.generate(params, np.zeros((1, 2), np.int32), max_new=0)
+        # empty prompt: prefill would be a no-op and the first token
+        # would come from the zero-initialized logits carry (ADVICE.md)
+        with pytest.raises(ValueError, match="prompt"):
+            lm.generate(params, np.zeros((1, 0), np.int32), max_new=1)
         moe = TinyCausalLM(vocab=8, dim=16, heads=2, layers=1, experts=2)
         with pytest.raises(NotImplementedError):
             moe.decode_step(moe.init(0), jnp.zeros(1, jnp.int32),

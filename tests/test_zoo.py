@@ -151,7 +151,7 @@ def test_normalization_rescaling_fold(rng):
     untouched."""
     import keras
 
-    def build(with_rescale, intervene=False):
+    def build(with_rescale, intervene=False, intervene_weightless=False):
         x = inp = keras.Input((8, 8, 3))
         # no explicit mean/variance: that path stores them as weights,
         # exactly how keras EfficientNet's normalization layer is built
@@ -159,6 +159,8 @@ def test_normalization_rescaling_fold(rng):
         x = norm(x)
         if intervene:
             x = keras.layers.Conv2D(3, 1, use_bias=False)(x)
+        if intervene_weightless:
+            x = keras.layers.Activation("relu")(x)
         if with_rescale:
             x = keras.layers.Rescaling([0.5, 0.5, 0.5])(x)
         x = keras.layers.Conv2D(2, 1)(x)
@@ -179,4 +181,11 @@ def test_normalization_rescaling_fold(rng):
                                [16.0, 16.0, 16.0])
     untouched = params_from_keras(build(True, intervene=True))
     np.testing.assert_allclose(untouched["normalization"]["variance"],
+                               [4.0, 4.0, 4.0])
+    # a weightLESS transforming layer (Activation) between them must
+    # ALSO close the fold window: relu then *s does not commute into
+    # the variance (ADVICE.md — the non-EfficientNet-graph mis-fold)
+    weightless = params_from_keras(
+        build(True, intervene_weightless=True))
+    np.testing.assert_allclose(weightless["normalization"]["variance"],
                                [4.0, 4.0, 4.0])

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""tpudl-check: the AST invariant linter over tpudl/, tools/, bench.py.
+
+The sixth repo gate, same shape as the five runtime validators
+(validate_metrics/shards/dump/status/job): pure stdlib + tpudl.analysis,
+importable (``from tpudl_check import run_check``) and runnable
+(``python -m tools.tpudl_check tpudl tools bench.py``). Where the
+validators check emitted ARTIFACTS, this checks the SOURCE for the
+invariants those artifacts assume — atomic writes, flag-only signal
+handlers, the shared RetryPolicy, no hot-path syncs, no swallowed
+excepts, and schema-stable knob/metric names (ANALYSIS.md).
+
+Exit codes (the validator convention): 0 clean, 2 findings, 1 error
+(unparseable file / bad usage).
+
+``--list-rules`` prints the rule table; ``--registry-audit`` prints the
+declared-vs-used delta for the knob/metric registries (the round-trip
+tests/test_analysis.py enforces) and exits 2 when they drift.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `python tools/tpudl_check.py` from anywhere
+    sys.path.insert(0, _REPO)
+
+from tpudl.analysis import (RULES, check_paths, collect_usage,  # noqa: E402
+                            is_declared_metric, iter_python_files,
+                            KNOB_NAMES, METRIC_NAMES, METRIC_PATTERNS)
+from tpudl.analysis.metric_names import matches_pattern_prefix  # noqa: E402
+
+USAGE = ("usage: tpudl_check.py [--list-rules] [--registry-audit] "
+         "<path> [path ...]")
+
+
+def run_check(paths, root: str = ".", out=sys.stderr):
+    """(findings, errors) with findings rendered to ``out``."""
+    findings, errors = check_paths(paths, root=root)
+    for f in findings:
+        print(f.render(), file=out)
+    for e in errors:
+        print(f"ERROR: {e}", file=out)
+    return findings, errors
+
+
+def registry_audit(paths, root: str = ".") -> list[str]:
+    """Declared-vs-used drift lines (empty = registries in sync)."""
+    usage = collect_usage(paths, root=root)
+    drift = []
+    for name in sorted(usage["knobs"] - KNOB_NAMES):
+        drift.append(f"knob used but not declared: {name}")
+    for name in sorted(KNOB_NAMES - usage["knobs"]):
+        drift.append(f"knob declared but never read: {name}")
+    for name in sorted(usage["metrics"] - METRIC_NAMES):
+        if not is_declared_metric(name):
+            drift.append(f"metric used but not declared: {name}")
+    for name in sorted(METRIC_NAMES - usage["metrics"]):
+        drift.append(f"metric declared but never published: {name}")
+    used_ht = usage["metric_patterns"]
+    for pat in METRIC_PATTERNS:
+        head, _, tail = pat.partition("*")
+        if (head, tail) not in used_ht:
+            drift.append(f"metric pattern declared but never used: {pat}")
+    for head, tail in sorted(used_ht):
+        if not matches_pattern_prefix(head, tail):
+            drift.append(f"dynamic metric family used but not "
+                         f"declared: {head}*{tail}")
+    return drift
+
+
+def main(argv) -> int:
+    args = list(argv[1:])
+    if "--list-rules" in args:
+        for rule, desc in RULES.items():
+            print(f"{rule:20s} {desc}")
+        return 0
+    audit = "--registry-audit" in args
+    if audit:
+        args.remove("--registry-audit")
+    unknown_flags = [a for a in args if a.startswith("-")]
+    if unknown_flags:
+        # a typo'd --registry-adit must NOT silently run a plain lint
+        # and report the audit as passed
+        print(f"ERROR: unknown option(s): {unknown_flags}", file=sys.stderr)
+        print(USAGE, file=sys.stderr)
+        return 1
+    paths = args
+    if not paths:
+        print(USAGE, file=sys.stderr)
+        return 1
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"ERROR: no such path(s): {missing}", file=sys.stderr)
+        return 1
+    unlintable = [p for p in paths
+                  if os.path.isfile(p) and not p.endswith(".py")]
+    if unlintable:
+        # an explicit file arg the scanner would drop means a CI line
+        # pointed at the wrong path is gating NOTHING — be loud
+        print(f"ERROR: not python file(s): {unlintable}", file=sys.stderr)
+        return 1
+    t0 = time.perf_counter()
+    if audit:
+        drift = registry_audit(paths)
+        for line in drift:
+            print(f"DRIFT: {line}", file=sys.stderr)
+        print(f"registry audit: {'in sync' if not drift else str(len(drift)) + ' drift(s)'}")
+        return 2 if drift else 0
+    findings, errors = run_check(paths)
+    dt = time.perf_counter() - t0
+    n_files = len(iter_python_files(paths))
+    print(f"tpudl-check: {n_files} files, {len(findings)} finding(s), "
+          f"{len(errors)} error(s) in {dt:.2f}s")
+    if errors:
+        return 1
+    return 2 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -12,8 +12,16 @@ malformed emission fails CI, not a downstream dashboard):
    — flat JSON, required keys, < 1500 chars, nothing nested deeper
    than one list-of-scalars).
 
-Pure stdlib, importable (``from validate_metrics import ...``) and
-runnable (``python tools/validate_metrics.py <file.jsonl>``).
+Opt-in third contract (``--check-names``): every metric NAME in the
+sink must be declared in the registry
+(:mod:`tpudl.analysis.metric_names`, ANALYSIS.md) — opt-in because a
+sink file may legitimately carry user-defined metrics, but tpudl's own
+emissions must match the schema the dashboards and the bench sentinel
+key on.
+
+Pure stdlib (the registry import is lazy, only under ``--check-names``),
+importable (``from validate_metrics import ...``) and runnable
+(``python tools/validate_metrics.py <file.jsonl>``).
 """
 
 from __future__ import annotations
@@ -127,14 +135,54 @@ def validate_bench_summary_line(line: str) -> list[str]:
     return errs
 
 
+def unknown_sink_names(metrics: dict) -> list[str]:
+    """Names in one line's ``metrics`` dict that the registry does not
+    declare (the ``--check-names`` cross-check)."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:  # runnable from anywhere, like the CLI
+        sys.path.insert(0, repo)
+    from tpudl.analysis.metric_names import unknown_metric_names
+
+    return unknown_metric_names(metrics)
+
+
+def check_file_names(path: str) -> list[str]:
+    """Undeclared metric names across every parseable line of a sink
+    file (empty = all names declared)."""
+    unknown: set[str] = set()
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # the schema pass reports these
+            metrics = obj.get("metrics")
+            if isinstance(metrics, dict):
+                unknown.update(unknown_sink_names(metrics))
+    return sorted(unknown)
+
+
 def main(argv) -> int:
-    if len(argv) != 2:
-        print("usage: validate_metrics.py <metrics.jsonl>", file=sys.stderr)
+    args = list(argv[1:])
+    check_names = "--check-names" in args
+    if check_names:
+        args.remove("--check-names")
+    if len(args) != 1:
+        print("usage: validate_metrics.py [--check-names] "
+              "<metrics.jsonl>", file=sys.stderr)
         return 2
-    errors, n, _last = validate_metrics_file(argv[1])
+    errors, n, _last = validate_metrics_file(args[0])
+    if check_names:
+        errors.extend(f"undeclared metric name: {name!r} (declare it "
+                      f"in tpudl/analysis/metric_names.py)"
+                      for name in check_file_names(args[0]))
     for e in errors:
         print(f"INVALID: {e}", file=sys.stderr)
-    print(f"{argv[1]}: {n} lines, "
+    print(f"{args[0]}: {n} lines, "
           f"{'OK' if not errors else str(len(errors)) + ' errors'}")
     return 1 if errors else 0
 

@@ -1,0 +1,34 @@
+"""tpudl.analysis — static enforcement of the codebase's contracts.
+
+Three pieces (ANALYSIS.md):
+
+- :mod:`tpudl.analysis.checker`: the AST invariant checker — eight
+  rules distilled from PRs 2–7 (atomic writes, flag-only signal
+  handlers, the one RetryPolicy, no hot-path device syncs, no silent
+  excepts, declared knobs/metrics, locked globals), with
+  ``# tpudl: ignore[rule] — reason`` suppressions;
+- :mod:`tpudl.analysis.knobs`: the registry of every ``TPUDL_*`` env
+  knob (the docs' knob tables render from it);
+- :mod:`tpudl.analysis.metric_names`: the registry of every
+  ``tpudl.obs`` metric name (shared with tools/validate_metrics.py).
+
+CLI: ``python -m tools.tpudl_check tpudl tools bench.py``
+(exit 0 clean / 2 findings / 1 error). Wired into run-tests.sh and
+tier-1 via tests/test_analysis.py.
+"""
+
+from .checker import (Finding, RULES, check_file, check_paths,
+                      check_source, collect_usage, iter_python_files)
+from .knobs import KNOBS, KNOB_NAMES, Knob, render_knob_table
+from .metric_names import (METRIC_NAMES, METRIC_PATTERNS, METRICS,
+                           Metric, is_declared_metric,
+                           render_metric_table, unknown_metric_names)
+
+__all__ = [
+    "Finding", "RULES", "check_file", "check_paths", "check_source",
+    "collect_usage", "iter_python_files",
+    "Knob", "KNOBS", "KNOB_NAMES", "render_knob_table",
+    "Metric", "METRICS", "METRIC_NAMES", "METRIC_PATTERNS",
+    "is_declared_metric", "render_metric_table",
+    "unknown_metric_names",
+]

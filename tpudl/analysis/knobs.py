@@ -1,0 +1,209 @@
+"""The machine-readable registry of every ``TPUDL_*`` environment knob.
+
+One declaration per knob: name, value kind, default, owning subsystem,
+and a one-line meaning. Three consumers share it (ANALYSIS.md):
+
+1. the static checker (:mod:`tpudl.analysis.checker`, rule
+   ``undeclared-knob``): every ``"TPUDL_*"`` string literal read in the
+   source must be declared here — an env read nobody documented is a
+   schema change nobody reviewed;
+2. the docs: the knob tables in ANALYSIS.md are rendered from this
+   module (:func:`render_knob_table`), so prose can't drift from code;
+3. the registry round-trip test (tests/test_analysis.py): every
+   declared knob is actually read somewhere, every read knob is
+   declared — deleting a knob's last use without deleting its
+   declaration fails CI, and vice versa.
+
+Adding a knob = add a :class:`Knob` entry here, then use the literal.
+The checker points at this file when it flags an undeclared read.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["Knob", "KNOBS", "KNOB_NAMES", "knobs_by_subsystem",
+           "render_knob_table"]
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str        # the full TPUDL_* env var
+    kind: str        # int | float | bool | str | enum | path | json
+    default: str     # rendered default ("" = unset / derived)
+    subsystem: str   # frame | data | obs | jobs | train | zoo | bench
+    help: str        # one line, present tense
+
+
+KNOBS: tuple[Knob, ...] = (
+    # -- frame executor (PIPELINE.md) ----------------------------------
+    Knob("TPUDL_FRAME_PREFETCH", "bool", "1", "frame",
+         "0 force-disables the pipelined executor (serial arm: no "
+         "prefetch, no prepare pool, no fusion)"),
+    Knob("TPUDL_FRAME_PREFETCH_DEPTH", "int", "2", "frame",
+         "bounded infeed queue depth (prepared batches in flight)"),
+    Knob("TPUDL_FRAME_PREPARE_WORKERS", "int", "2", "frame",
+         "prepare-pool threads packing/decoding batches concurrently"),
+    Knob("TPUDL_FRAME_FUSE_STEPS", "int", "1", "frame",
+         "microbatches per compiled lax.scan dispatch (1 = off)"),
+    Knob("TPUDL_FRAME_IO_WORKERS", "int", "8", "frame",
+         "LazyFileColumn file-read threads"),
+    Knob("TPUDL_FRAME_DECODE_WORKERS", "int", "1", "frame",
+         "image-decode threads per batch slice"),
+    Knob("TPUDL_DECODE_THREADS", "int", "", "frame",
+         "native image loader decode threads (default: native layer "
+         "picks)"),
+    Knob("TPUDL_PIPELINE_RING", "int", "16", "frame",
+         "PipelineReports retained in the bounded ring"),
+    # -- data: wire codecs + shard cache (DATA.md) ---------------------
+    Knob("TPUDL_WIRE_CODEC", "enum", "", "data",
+         "wire codec for map_batches inputs: identity|u8|bf16|auto "
+         "(unset = off)"),
+    Knob("TPUDL_WIRE_MBPS", "float", "", "data",
+         "H2D bandwidth override in MB/s (skips the bare-device_put "
+         "wire probe; also read by the roofline model)"),
+    Knob("TPUDL_DATA_BF16_WIRE_MBPS", "float", "1000", "data",
+         "wire speed below which codec 'auto' picks bf16 for float "
+         "columns"),
+    Knob("TPUDL_DATA_CACHE_DIR", "path", "", "data",
+         "prepared-batch shard cache directory (unset = cache off)"),
+    Knob("TPUDL_DATA_VERIFY", "enum", "first", "data",
+         "shard checksum policy: first|always|never"),
+    # -- observability (OBSERVABILITY.md) ------------------------------
+    Knob("TPUDL_METRICS_FILE", "path", "", "obs",
+         "JSONL metrics sink path (unset = no sink)"),
+    Knob("TPUDL_METRICS_FLUSH_S", "float", "60", "obs",
+         "min seconds between periodic metrics-sink flushes"),
+    Knob("TPUDL_TRACE_RING", "int", "65536", "obs",
+         "host-span tracer ring capacity"),
+    Knob("TPUDL_STATUS_DIR", "path", "", "obs",
+         "arms the live status writer: tpudl-status-<pid>.json lands "
+         "here (unset = off)"),
+    Knob("TPUDL_STATUS_INTERVAL_S", "float", "1.0", "obs",
+         "live status writer period (floor 0.05)"),
+    Knob("TPUDL_WATCHDOG_STALL_S", "float", "0", "obs",
+         "heartbeat age that flags a stall; > 0 lazily starts the "
+         "watchdog daemon (0/unset = off)"),
+    Knob("TPUDL_FLIGHT_DIR", "path", "", "obs",
+         "flight-recorder dump directory (default: cwd)"),
+    Knob("TPUDL_FLIGHT_BATCHES", "int", "32", "obs",
+         "flight recorder: batch-descriptor ring capacity"),
+    Knob("TPUDL_FLIGHT_ERRORS", "int", "64", "obs",
+         "flight recorder: error ring capacity"),
+    Knob("TPUDL_FLIGHT_STALLS", "int", "16", "obs",
+         "flight recorder: stall-event ring capacity"),
+    Knob("TPUDL_FLIGHT_TICKS", "int", "32", "obs",
+         "flight recorder: metric-tick ring capacity"),
+    Knob("TPUDL_FLIGHT_SPANS", "int", "512", "obs",
+         "span-ring tail length embedded in a dump"),
+    Knob("TPUDL_FAULTHANDLER", "bool", "0", "obs",
+         "1 wires stdlib faulthandler to tpudl-fault-<pid>.log for "
+         "native (libtpu/XLA) crashes"),
+    Knob("TPUDL_DEVICE_MS_PER_STEP", "float", "0", "obs",
+         "measured device ms/step fed to the roofline model (0/unset "
+         "= derive from the report)"),
+    # -- jobs / train / retries (JOBS.md) ------------------------------
+    Knob("TPUDL_RETRY_IO_ATTEMPTS", "int", "3", "jobs",
+         "io_policy() total attempts per file operation (1 disables)"),
+    Knob("TPUDL_RETRY_IO_BACKOFF_S", "float", "0.05", "jobs",
+         "io_policy() base backoff seconds (exponential + jitter)"),
+    Knob("TPUDL_HPO_TRIAL_ATTEMPTS", "int", "1", "jobs",
+         "attempts per HPO trial (unset/1 = no retry)"),
+    Knob("TPUDL_TRAIN_RESTART_BACKOFF_S", "float", "0.1", "train",
+         "gang-restart base backoff seconds (HorovodRunner)"),
+    Knob("TPUDL_FAULT_PLAN", "json", "", "jobs",
+         "fault-injection plan JSON (tpudl.testing.faults), honored "
+         "across process boundaries"),
+    # -- zoo / compile cache -------------------------------------------
+    Knob("TPUDL_WEIGHTS_DIR", "path", "", "zoo",
+         "offline pretrained-weights directory (<model>.npz artifacts)"),
+    Knob("TPUDL_IMAGENET_CLASS_INDEX", "path", "", "zoo",
+         "imagenet class-index JSON override (else keras cache)"),
+    Knob("TPUDL_S2D_STEM", "bool", "0", "zoo",
+         "1 enables the space-to-depth conv stem (defaults OFF: slower "
+         "on this backend, see zoo/s2d.py)"),
+    Knob("TPUDL_COMPILE_CACHE_DIR", "path",
+         "~/.cache/tpudl/xla_cache", "zoo",
+         "persistent XLA compilation cache directory (0 disables)"),
+    # -- bench (bench.py header) ---------------------------------------
+    Knob("TPUDL_BENCH_BUDGET_S", "float", "2400", "bench",
+         "soft wall-clock budget; remaining sub-benches skip past it"),
+    Knob("TPUDL_BENCH_DEADLINE_S", "float", "3300", "bench",
+         "hard watchdog backstop: dump + emit the partial summary"),
+    Knob("TPUDL_BENCH_SUBBENCH_FRAC", "float", "0.5", "bench",
+         "max fraction of the remaining budget one sub-bench may spend"),
+    Knob("TPUDL_BENCH_QUICK", "bool", "0", "bench",
+         "1 runs the headline config only with shrunk trial counts"),
+    Knob("TPUDL_BENCH_DTYPE", "str", "bfloat16", "bench",
+         "compute dtype for the featurize benches"),
+    Knob("TPUDL_BENCH_BATCH", "int", "256", "bench",
+         "featurize batch size"),
+    Knob("TPUDL_BENCH_N", "int", "1024", "bench",
+         "featurize row count"),
+    Knob("TPUDL_BENCH_TRIALS", "int", "2", "bench",
+         "trials per arm (sync-mode phase)"),
+    Knob("TPUDL_BENCH_STREAM_TRIALS", "int", "4", "bench",
+         "streaming-phase subprocess trials per arm (0 disables; 1 "
+         "when quick)"),
+    Knob("TPUDL_BENCH_STREAM_BUDGET_S", "float", "1500", "bench",
+         "streaming phase: stop starting trials past this wall-clock"),
+    Knob("TPUDL_BENCH_TRIAL_TIMEOUT_S", "float", "450", "bench",
+         "per-subprocess trial kill timeout"),
+    Knob("TPUDL_BENCH_SKIP_BASELINE", "bool", "0", "bench",
+         "1 skips the TF-CPU baseline side"),
+    Knob("TPUDL_BENCH_RECORD_NAME", "str", "BENCH_r05_full", "bench",
+         "basename for the full record written to bench_records/"),
+    Knob("TPUDL_BENCH_COMPUTE_ITERS", "int", "8", "bench",
+         "compute-only sub-bench iterations"),
+    Knob("TPUDL_BENCH_COMPUTE_BATCH", "int", "256", "bench",
+         "compute-only sub-bench batch size"),
+    Knob("TPUDL_BENCH_CURVE_STEPS", "int", "120", "bench",
+         "training-curve sub-bench step count"),
+    Knob("TPUDL_BENCH_CURVE_BATCH", "int", "32", "bench",
+         "training-curve sub-bench batch size"),
+    Knob("TPUDL_BENCH_TRAIN_BATCH", "int", "64", "bench",
+         "horovod-train sub-bench batch size"),
+    Knob("TPUDL_BENCH_TRAIN_STEPS", "int", "10", "bench",
+         "horovod-train sub-bench step count"),
+    Knob("TPUDL_BENCH_MLP_ROWS", "int", "65536", "bench",
+         "keras-transformer MLP sub-bench row count"),
+    Knob("TPUDL_BENCH_PRED_N", "int", "512", "bench",
+         "predictor sub-bench image count"),
+    Knob("TPUDL_BENCH_EST_INC_FILES", "int", "96", "bench",
+         "incremental-estimator sub-bench file count"),
+    Knob("TPUDL_BENCH_EST_INC_BATCH", "int", "16", "bench",
+         "incremental-estimator sub-bench batch size"),
+    Knob("TPUDL_BENCH_DECODE_N", "int", "256", "bench",
+         "decode sub-bench image count"),
+    Knob("TPUDL_BENCH_DATA_N", "int", "512", "bench",
+         "data-pipeline sub-bench row count"),
+    Knob("TPUDL_BENCH_DATA_FILES", "int", "192", "bench",
+         "data-pipeline cache sub-bench file count"),
+    Knob("TPUDL_BENCH_FLASH_SEQS", "str", "2048,4096,8192,16384",
+         "bench", "flash-attention sub-bench sequence-length ladder"),
+    Knob("TPUDL_BENCH_PREEMPT_STEPS", "int", "300", "bench",
+         "preemption sub-bench child-job step count"),
+)
+
+KNOB_NAMES = frozenset(k.name for k in KNOBS)
+
+
+def knobs_by_subsystem() -> dict[str, list[Knob]]:
+    out: dict[str, list[Knob]] = {}
+    for k in KNOBS:
+        out.setdefault(k.subsystem, []).append(k)
+    return out
+
+
+def render_knob_table(subsystem: str | None = None) -> str:
+    """Markdown table of (a subsystem's) knobs — the docs' single
+    source (ANALYSIS.md embeds the output verbatim)."""
+    rows = [k for k in KNOBS
+            if subsystem is None or k.subsystem == subsystem]
+    lines = ["| knob | kind | default | meaning |",
+             "|---|---|---|---|"]
+    for k in rows:
+        default = k.default if k.default != "" else "*(unset)*"
+        lines.append(f"| `{k.name}` | {k.kind} | `{default}` "
+                     f"| {k.help} |")
+    return "\n".join(lines)

@@ -1,0 +1,192 @@
+"""The registry of ``tpudl.obs`` metric names.
+
+Every counter/gauge/histogram name the codebase publishes is declared
+here, exactly once, so the name schema is reviewable in one place
+(ANALYSIS.md). Consumers:
+
+1. the static checker (rule ``undeclared-metric``): a literal (or
+   f-string) name at a ``counter(...)``/``gauge(...)``/
+   ``histogram(...)`` call site must match a declaration — dashboards
+   and the bench sentinel key on these strings, so an unreviewed
+   rename is a silent break;
+2. ``tools/validate_metrics.py``: the JSONL-sink validator can
+   cross-check emitted names against this registry (opt-in
+   ``--check-names`` — sink files may legitimately carry user-defined
+   metrics);
+3. the round-trip test (tests/test_analysis.py): declared ⊆ used and
+   used ⊆ declared over ``tpudl/``, ``tools/``, ``bench.py``.
+
+Families with a runtime-computed segment (``frame.stage.<name>.seconds``)
+are declared as patterns with exactly one ``*`` segment; the checker
+matches an f-string's constant head/tail against them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fnmatch import fnmatchcase
+
+__all__ = ["Metric", "METRICS", "METRIC_NAMES", "METRIC_PATTERNS",
+           "is_declared_metric", "unknown_metric_names",
+           "render_metric_table"]
+
+
+@dataclass(frozen=True)
+class Metric:
+    name: str     # exact dotted name, or a pattern with one '*'
+    kind: str     # counter | gauge | histogram | report-gauge
+    help: str
+
+
+METRICS: tuple[Metric, ...] = (
+    # -- frame executor ------------------------------------------------
+    Metric("frame.map_batches.runs", "counter",
+           "map_batches runs finished"),
+    Metric("frame.map_batches.rows", "counter",
+           "rows processed across runs"),
+    Metric("frame.map_batches.batches", "counter",
+           "dispatches issued across runs"),
+    Metric("frame.map_batches.wall_seconds", "histogram",
+           "wall time per run"),
+    Metric("frame.stage.*.seconds", "counter",
+           "cumulative seconds per executor stage "
+           "(prepare/h2d/dispatch/d2h/infeed_wait)"),
+    Metric("frame.overlap_efficiency", "gauge",
+           "1 - infeed_wait/prepare for the last run"),
+    Metric("queue_depth", "report-gauge",
+           "infeed queue depth sampled per batch (PipelineReport)"),
+    Metric("wire_batch_bytes", "report-gauge",
+           "bytes shipped per batch (PipelineReport)"),
+    # -- data: codecs + shard cache ------------------------------------
+    Metric("data.wire.bytes_shipped", "counter",
+           "encoded bytes put on the H2D wire"),
+    Metric("data.wire.bytes_dense", "counter",
+           "what the same batches would have shipped un-encoded"),
+    Metric("data.wire.bytes_saved", "counter",
+           "dense minus shipped"),
+    Metric("data.codec.encode_seconds", "counter",
+           "host time spent wire-encoding"),
+    Metric("data.codec.*.batches", "counter",
+           "batches encoded per codec (identity/u8/bf16)"),
+    Metric("data.cache.hits", "counter", "shard-cache verified hits"),
+    Metric("data.cache.misses", "counter", "shard-cache misses"),
+    Metric("data.cache.puts", "counter", "shards written"),
+    Metric("data.cache.corrupt", "counter",
+           "shards failing checksum (re-prepared, never fatal)"),
+    Metric("data.cache.evicted", "counter",
+           "shards unlinked by eviction mid-read (treated as a miss)"),
+    Metric("data.cache.bytes_read", "counter", "shard bytes read"),
+    Metric("data.cache.bytes_written", "counter", "shard bytes written"),
+    # -- image IO ------------------------------------------------------
+    Metric("imageio.files_read", "counter", "files read off disk"),
+    Metric("imageio.bytes_read", "counter", "bytes read off disk"),
+    Metric("imageio.decode_errors", "counter",
+           "undecodable images (null row, error ring sample)"),
+    Metric("imageio.memo_hits", "counter",
+           "LazyFileColumn memo hits (no re-read)"),
+    Metric("imageio.uris_loaded", "counter",
+           "URIs loaded via load_uri_batch"),
+    # -- ml / hpo / tuning ---------------------------------------------
+    Metric("estimator.trials", "counter", "estimator tuning trials run"),
+    Metric("estimator.train_steps", "counter",
+           "estimator train steps across trials"),
+    Metric("estimator.trial_final_loss", "gauge",
+           "last trial's final loss"),
+    Metric("hpo.trials_started", "counter", "HPO trials started"),
+    Metric("hpo.trials_completed", "counter", "HPO trials completed"),
+    Metric("hpo.trials_failed", "counter",
+           "HPO trials failed (after retries)"),
+    Metric("hpo.trial_seconds", "histogram", "wall time per HPO trial"),
+    Metric("hpo.trial_retries", "counter",
+           "HPO trial attempts beyond the first"),
+    Metric("ml.*.transforms", "counter",
+           "transform() calls per ml transformer class"),
+    Metric("ml.*.rows_in", "counter",
+           "rows entering transform() per transformer class"),
+    Metric("ml.*.rows_out", "counter",
+           "rows leaving transform() per transformer class"),
+    Metric("ml.*.fits", "counter",
+           "fit() calls per estimator class"),
+    Metric("udf.*.calls", "counter",
+           "invocations per registered UDF"),
+    Metric("udf.*.rows", "counter",
+           "rows processed per registered UDF"),
+    Metric("tuning.cv_folds", "counter", "cross-validation folds run"),
+    Metric("tuning.cv_evaluations", "counter",
+           "cross-validation model evaluations"),
+    Metric("tuning.cv_last_metric", "gauge", "last CV fold metric"),
+    Metric("tuning.cv_best_metric", "gauge", "best CV metric so far"),
+    # -- train ---------------------------------------------------------
+    Metric("train.steps", "counter", "optimizer steps taken"),
+    Metric("train.examples", "counter", "examples consumed"),
+    Metric("train.step_seconds", "histogram", "wall time per step"),
+    Metric("train.last_step", "gauge",
+           "last completed step (live progress)"),
+    Metric("train.restarts", "counter", "gang restarts"),
+    Metric("train.restart_backoff_s", "histogram",
+           "backoff slept before each gang restart"),
+    Metric("train.checkpoint_save_seconds", "histogram",
+           "wall time per checkpoint save"),
+    Metric("train.checkpoint_restore_seconds", "histogram",
+           "wall time per checkpoint restore"),
+    Metric("train.checkpoint.corrupt", "counter",
+           "checkpoints failing checksum on restore (fell back)"),
+    # -- jobs / retries ------------------------------------------------
+    Metric("retry.attempts", "counter",
+           "retry attempts across all RetryPolicy call sites"),
+    Metric("retry.*", "counter",
+           "retry attempts per kind (io.read, hpo.trial, ...)"),
+    Metric("retry.backoff_s", "histogram",
+           "seconds slept per retry backoff"),
+    # -- obs self-metrics ----------------------------------------------
+    Metric("obs.watchdog.stalls", "counter",
+           "heartbeats flagged stalled (once per episode)"),
+    Metric("obs.roofline.achieved_rows_per_s", "gauge",
+           "measured end-to-end throughput (roofline input)"),
+    Metric("obs.roofline.achievable_rows_per_s", "gauge",
+           "modeled throughput with the gap closed"),
+    Metric("obs.roofline.predicted_gain_pct", "gauge",
+           "top advisor recommendation's predicted gain"),
+    Metric("obs.roofline.gap_frac.*", "gauge",
+           "device-vs-e2e gap share attributed per component "
+           "(prepare/wire_h2d/dispatch/d2h/other)"),
+)
+
+METRIC_NAMES = frozenset(m.name for m in METRICS if "*" not in m.name)
+METRIC_PATTERNS = tuple(m.name for m in METRICS if "*" in m.name)
+
+
+def is_declared_metric(name: str) -> bool:
+    """Exact-name membership, falling back to the one-'*' patterns."""
+    if name in METRIC_NAMES:
+        return True
+    return any(fnmatchcase(name, p) for p in METRIC_PATTERNS)
+
+
+def matches_pattern_prefix(head: str, tail: str = "") -> bool:
+    """True when an f-string name with constant ``head``/``tail`` around
+    one dynamic segment fits a declared pattern (the checker's view of
+    ``f"frame.stage.{name}.seconds"``: head ``frame.stage.``, tail
+    ``.seconds``). Containment, not equality: ``f"retry.io.{op}"``
+    (head ``retry.io.``) expands only to names the declared ``retry.*``
+    already covers, so a sub-family under a declared pattern needs no
+    redundant registry entry."""
+    for p in METRIC_PATTERNS:
+        ph, _, pt = p.partition("*")
+        if head.startswith(ph) and tail.endswith(pt):
+            return True
+    return False
+
+
+def unknown_metric_names(names) -> list[str]:
+    """The subset of ``names`` not declared here (for the JSONL-sink
+    validator's opt-in cross-check)."""
+    return sorted(n for n in set(names) if not is_declared_metric(n))
+
+
+def render_metric_table() -> str:
+    """Markdown table of the declared names (ANALYSIS.md embeds it)."""
+    lines = ["| metric | kind | meaning |", "|---|---|---|"]
+    for m in METRICS:
+        lines.append(f"| `{m.name}` | {m.kind} | {m.help} |")
+    return "\n".join(lines)

@@ -282,7 +282,10 @@ def probe_wire_mbps(mb: int = 4) -> float | None:
             t0 = time.perf_counter()
             jax.block_until_ready(jax.device_put(x))
             mbps = mb / (time.perf_counter() - t0)
-        except Exception:  # no backend / wedged RPC: unknown, not fast
+        # tpudl: ignore[swallowed-except] — no backend / wedged RPC
+        # means UNKNOWN wire speed; None makes every caller treat the
+        # wire as not-fast (the conservative codec pick)
+        except Exception:
             mbps = None
         _WIRE_MBPS_CACHE["mbps"] = mbps
         return mbps

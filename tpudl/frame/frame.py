@@ -196,6 +196,8 @@ def _is_device_fn(fn) -> bool:
         try:
             if isinstance(fn, jax.stages.Wrapped):
                 return True
+        # tpudl: ignore[swallowed-except] — jax API drift guard: an
+        # exotic jax falls through to the hasattr heuristic below
         except Exception:  # pragma: no cover - jax API drift
             pass
     return hasattr(fn, "lower")
@@ -728,6 +730,11 @@ class Frame:
                         if prefetch:
                             import jax
 
+                            # tpudl: ignore[hot-sync] — deliberate: this
+                            # barrier runs on a PREPARE-POOL thread so
+                            # the copy lands while the main thread keeps
+                            # dispatching; removing it would move the
+                            # wait INTO dispatch
                             jax.block_until_ready(packed)  # the copy, HERE
                 # mesh=None: host arrays go straight into the jitted fn even
                 # when prefetching — the runtime's own arg transfer pipelines
@@ -911,7 +918,7 @@ def _pick_fetch_mode(result, est_total_rows: int) -> str:
     return "acc" if per_row * est_total_rows <= _ACC_FETCH_CAP else "window"
 
 
-def _fetch_accumulated(acc, segs, outputs):
+def _fetch_accumulated(acc, segs, outputs):  # tpudl: hot-path
     """Concatenate per-column device results and fetch each ONCE; strip
     per-batch mesh padding host-side."""
     import jax.numpy as jnp
@@ -920,6 +927,8 @@ def _fetch_accumulated(acc, segs, outputs):
         if not chunks:
             continue
         cat = jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
+        # tpudl: ignore[hot-sync] — this fetch IS the d2h stage: one
+        # round-trip per COLUMN at run end (the whole point of acc mode)
         host = np.asarray(cat)
         if any(n_pad for _, n_pad in segs):
             parts, pos = [], 0
@@ -931,9 +940,12 @@ def _fetch_accumulated(acc, segs, outputs):
             outputs[i].append(host)
 
 
-def _drain(entry, outputs):
+def _drain(entry, outputs):  # tpudl: hot-path
     (result, n_pad) = entry
     for i, r in enumerate(result):
+        # tpudl: ignore[hot-sync] — this fetch IS the d2h stage; the
+        # copy was started async at dispatch (copy_to_host_async), so
+        # this blocks only on the oldest window entry
         r = np.asarray(r)  # device→host; blocks until this batch is done
         outputs[i].append(r[: r.shape[0] - n_pad] if n_pad else r)
 

@@ -145,8 +145,10 @@ class RetryPolicy:
                 max_attempts=self.max_attempts,
                 backoff_s=round(float(backoff_s), 4)
                 if backoff_s is not None else None)
+        # tpudl: ignore[swallowed-except] — the observer must never
+        # take down the retried op; obs absent/broken = silent retry
         except Exception:
-            pass  # the observer must never take down the retried op
+            pass
 
 
 def _env_float(name: str, default: float) -> float:

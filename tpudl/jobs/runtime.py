@@ -279,6 +279,8 @@ class JobRuntime:
                 name=self.spec.name, fingerprint=fp[:12],
                 attempt=m["attempt"], resumed=prev is not None,
                 manifest=self.manifest_path())
+        # tpudl: ignore[swallowed-except] — guards the job.start
+        # breadcrumb itself; the run must start regardless
         except Exception:
             pass
         return JobContext(self, m)
@@ -327,8 +329,19 @@ class JobRuntime:
                     ckpt_dir, save_every=self.spec.save_every
                 ).latest_step()
                 m["checkpoint"] = {"dir": "checkpoints", "step": step}
-        except Exception:
-            pass
+        except Exception as e:
+            # a stale pointer is recoverable (restore falls back to the
+            # newest VALID step) but the WHY belongs in the black box —
+            # an unreadable checkpoint dir here is early evidence
+            try:
+                from tpudl.obs import flight as _flight
+
+                _flight.record_error("job.checkpoint_pointer", e,
+                                     workdir=self.spec.workdir)
+            # tpudl: ignore[swallowed-except] — guards the breadcrumb
+            # itself; pointer refresh stays best-effort either way
+            except Exception:
+                pass
 
     def _preempted(self) -> JobPreempted:
         """Finalize preempted state → the JobPreempted to raise."""
@@ -351,6 +364,9 @@ class JobRuntime:
                 reason="preempted_resumable",
                 path=os.path.join(self.spec.workdir,
                                   f"tpudl-dump-{os.getpid()}.json.gz"))
+        # tpudl: ignore[swallowed-except] — forensics must never block
+        # the preemption exit path; the manifest (already persisted
+        # above) is the resume contract, the dump is evidence
         except Exception:
             pass
         return JobPreempted(self.manifest_path(), m.get("cursor") or {})
@@ -385,6 +401,8 @@ class JobRuntime:
 
                 _flight.get_recorder().record_event(
                     "job.done", manifest=self.manifest_path())
+            # tpudl: ignore[swallowed-except] — guards the job.done
+            # breadcrumb; the result is already in hand
             except Exception:
                 pass
             return result
@@ -402,6 +420,8 @@ class JobRuntime:
 
                 _flight.record_error("job.failed", e,
                                      manifest=self.manifest_path())
+            # tpudl: ignore[swallowed-except] — guards the job.failed
+            # breadcrumb; the re-raise below carries the real error
             except Exception:
                 pass
             raise

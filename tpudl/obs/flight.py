@@ -91,6 +91,8 @@ def _jax_info() -> dict:
         return info
     try:
         info["version"] = getattr(jax, "__version__", None)
+    # tpudl: ignore[swallowed-except] — best-effort probe of a possibly
+    # wedged runtime; a missing key IS the evidence
     except Exception:
         pass
     for key, fn in (("process_index", "process_index"),
@@ -98,10 +100,14 @@ def _jax_info() -> dict:
                     ("device_count", "device_count")):
         try:
             info[key] = int(getattr(jax, fn)())
+        # tpudl: ignore[swallowed-except] — best-effort probe of a
+        # possibly wedged runtime; a missing key IS the evidence
         except Exception:
             pass
     try:
         info["backend"] = jax.default_backend()
+    # tpudl: ignore[swallowed-except] — best-effort probe of a possibly
+    # wedged runtime; a missing key IS the evidence
     except Exception:
         pass
     return info
@@ -183,8 +189,11 @@ class FlightRecorder:
                                for a in arrays],
                     "fingerprint": batch_fingerprint(arrays)}
             desc.update(info)
+        # tpudl: ignore[swallowed-except] — per-batch hot-path hook:
+        # the observer must never take down the pipeline, and there is
+        # no cheaper breadcrumb channel than this recorder itself
         except Exception:
-            return  # the observer must never take down the pipeline
+            return
         with self._lock:
             self._batches.append(desc)
 
@@ -246,6 +255,8 @@ class FlightRecorder:
             from tpudl.obs import metrics as _m
 
             snap = _m.snapshot()
+        # tpudl: ignore[swallowed-except] — periodic tick: a broken
+        # metrics registry just means a sparser trajectory in the dump
         except Exception:
             return
         with self._lock:
@@ -295,12 +306,16 @@ class FlightRecorder:
             from tpudl.obs import metrics as _m
 
             payload["metrics"] = _m.snapshot()
+        # tpudl: ignore[swallowed-except] — dying-interpreter dump
+        # takes what it can get; the empty default marks the gap
         except Exception:
             payload["metrics"] = {}
         try:
             from tpudl.obs import pipeline as _p
 
             payload["pipeline_reports"] = _p.pipeline_reports()
+        # tpudl: ignore[swallowed-except] — dying-interpreter dump
+        # takes what it can get; the empty default marks the gap
         except Exception:
             payload["pipeline_reports"] = {}
         try:
@@ -313,12 +328,16 @@ class FlightRecorder:
                  "tid": s.tid, "thread": s.thread_name,
                  "attrs": dict(s.attrs) if s.attrs else None}
                 for s in spans]
+        # tpudl: ignore[swallowed-except] — dying-interpreter dump
+        # takes what it can get; the empty default marks the gap
         except Exception:
             payload["spans"] = []
         try:
             from tpudl.obs import watchdog as _w
 
             payload["heartbeats"] = _w.get_registry().describe()
+        # tpudl: ignore[swallowed-except] — dying-interpreter dump
+        # takes what it can get; the empty default marks the gap
         except Exception:
             payload["heartbeats"] = {}
         return payload
@@ -415,10 +434,12 @@ class FlightRecorder:
             try:
                 prev = signal.getsignal(sig)
 
+                # tpudl: ignore[signal-handler] — THE forensics
+                # handler: dump() assembles on a bounded WORKER thread
+                # (timeout=10) so an interrupted frame holding an obs
+                # lock can't deadlock it, then chains/re-raises for
+                # default exit semantics
                 def handler(signum, frame, _prev=prev):
-                    # signal context: the interrupted frame may hold an
-                    # obs lock — bounded worker-thread dump, never an
-                    # inline snapshot (see dump(timeout=...))
                     self.dump(reason=f"signal:{signum}", timeout=10.0)
                     if callable(_prev):
                         _prev(signum, frame)
@@ -444,6 +465,9 @@ class FlightRecorder:
                 faulthandler.enable(file=self._fault_file,
                                     all_threads=True)
                 self.record_event("faulthandler", path=fault_path)
+            # tpudl: ignore[swallowed-except] — opt-in extra: an
+            # unwritable fault log must not break install(); the reset
+            # to None records that it is off
             except Exception:
                 self._fault_file = None
         self.record_event("install")

@@ -144,12 +144,16 @@ def collect_status(roofline: bool = True) -> dict:
                                        allow_probe=False)
                 if rr is not None:
                     payload["roofline"] = rr.to_dict()
+    # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
+    # contributor drops its section, never the whole status file
     except Exception:
         pass
     try:
         from tpudl.obs import watchdog as _watchdog
 
         payload["heartbeats"] = _watchdog.get_registry().describe()
+    # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
+    # contributor drops its section, never the whole status file
     except Exception:
         pass
     try:
@@ -158,6 +162,8 @@ def collect_status(roofline: bool = True) -> dict:
         payload["metrics"] = {
             name: m for name, m in _metrics.snapshot().items()
             if name.startswith(_METRIC_PREFIXES)}
+    # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
+    # contributor drops its section, never the whole status file
     except Exception:
         pass
     return payload
@@ -416,6 +422,8 @@ def top_main(status_dir: str, once: bool = False,
                 return 0 if statuses else 2
             # clear + home, then the frame (plain ANSI — no curses dep)
             print("\x1b[2J\x1b[H" + frame, file=out, flush=True)
+            # tpudl: ignore[adhoc-retry] — the interactive top refresh
+            # cadence, not a retry: nothing failed, nothing backs off
             time.sleep(max(0.2, interval))
         except KeyboardInterrupt:  # pragma: no cover - interactive
             return 0

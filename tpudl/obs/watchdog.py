@@ -322,7 +322,9 @@ def heartbeat(name: str, **info) -> Heartbeat:
         from tpudl.obs import live as _live
 
         _live.ensure_status_writer()
-    except Exception:  # the observer never kills the observed
+    # tpudl: ignore[swallowed-except] — the observer never kills the
+    # observed: a broken status writer just means no obs top
+    except Exception:
         pass
     return _REGISTRY.start(name, **info)
 

@@ -187,6 +187,8 @@ class FaultPlan:
                 action=matched.action, call=matched.calls,
                 **{k: v for k, v in ctx.items()
                    if isinstance(v, (int, float, str, bool, type(None)))})
+        # tpudl: ignore[swallowed-except] — guards the fault
+        # breadcrumb; the injected fault below must still fire
         except Exception:
             pass
         if matched.action == "sigterm":

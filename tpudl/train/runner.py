@@ -206,6 +206,9 @@ class HorovodRunner:
                     "checkpoint in %.2fs", attempt, self.max_restarts,
                     delay)
                 if delay > 0:
+                    # tpudl: ignore[adhoc-retry] — the pacing COMES
+                    # from the shared RetryPolicy (recorded above);
+                    # this sleep is the gang-restart boundary itself
                     time.sleep(delay)
 
 
@@ -244,8 +247,8 @@ class Trainer:
         self._step_fn = make_train_step(loss_fn, optimizer, mesh,
                                         param_shardings=param_shardings)
 
-    def fit(self, params, data_fn, steps: int, *, opt_state=None,
-            stop=None):
+    def fit(self, params, data_fn, steps: int, *,  # tpudl: hot-path
+            opt_state=None, stop=None):
         """Train for ``steps`` total steps (resuming included). Returns
         (params, opt_state, history).
 
@@ -443,6 +446,9 @@ class Trainer:
                     batch = (batch,)
                 if multi_host:
                     batch = tuple(
+                        # tpudl: ignore[hot-sync] — data_fn yields HOST
+                        # arrays; this asarray is the H2D staging copy
+                        # of the local shard, not a device round-trip
                         D.global_batch(np.asarray(b), self.mesh)
                         for b in batch)
                 elif shard_inputs:
@@ -462,6 +468,9 @@ class Trainer:
                         log.debug("checkpoint at step %d", done)
                 if self.log_every and done % self.log_every == 0:
                     dt = time.perf_counter() - t0
+                    # tpudl: ignore[hot-sync] — opt-in loss logging:
+                    # the fetch is the feature, paid once per
+                    # log_every steps and off by default
                     l = float(jax.device_get(loss))
                     self.history.append(
                         {"step": done, "loss": l,
@@ -472,7 +481,10 @@ class Trainer:
                                      or self.history[-1]["step"] != steps):
                 dt = time.perf_counter() - t0
                 self.history.append(
-                    {"step": steps, "loss": float(jax.device_get(loss)),
+                    {"step": steps,
+                     # tpudl: ignore[hot-sync] — after the last step:
+                     # the run's final loss fetch, no pipeline behind it
+                     "loss": float(jax.device_get(loss)),
                      "examples_per_sec": examples / max(dt, 1e-9)})
             if mgr is not None and steps > start:
                 t_ck = time.perf_counter()

@@ -90,6 +90,15 @@ def params_from_keras(model) -> dict:
             last_norm = None
             continue
         if cls not in _BASE_NAMES or not layer.weights:
+            # any intervening transforming layer ALSO ends the fold
+            # window: (x-m)/sqrt(v) then f(...) then *s only commutes
+            # into the variance when f is absent. Only a true
+            # pass-through (InputLayer) keeps the window open — an
+            # Activation/ZeroPadding2D between the Normalization and a
+            # later per-channel Rescaling must not let the fold
+            # mis-apply on a non-EfficientNet graph.
+            if cls != "InputLayer":
+                last_norm = None
             continue
         name = names[layer.name]
         # a fold is only valid while Normalization is the most recent

@@ -460,6 +460,12 @@ class TinyCausalLM:
                              f"max_len {self.max_len}")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if plen < 1:
+            # an empty prompt makes the prefill scan a no-op: the first
+            # token would be picked from the zero-initialized logits
+            # carry (always argmax of zeros), never from the model
+            raise ValueError(f"prompt must hold >= 1 token, got shape "
+                             f"{tuple(prompt.shape)}")
         if temperature > 0 and rng is None:
             raise ValueError("sampling (temperature > 0) needs rng=")
 
