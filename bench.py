@@ -202,10 +202,12 @@ def _install_sigterm_flush(record: dict):
     survive an external timeout. Returns the handler (tests call it
     directly)."""
 
-    # tpudl: ignore[signal-handler] — this handler terminates the
-    # process: it dumps on a bounded worker thread (timeout=), prints
-    # the judged line lock-free (the whole point, see comments below),
-    # and os._exit()s — nothing here returns into interrupted code
+    # tpudl: ignore[signal-handler, signal-lock] — this handler
+    # terminates the process: it dumps on a bounded worker thread
+    # (timeout= — any obs lock the interrupted frame holds is waited
+    # on OFF the signal frame and abandoned, never deadlocked on),
+    # prints the judged line lock-free (the whole point, see comments
+    # below), and os._exit()s — nothing returns into interrupted code
     def handler(signum, frame):
         log(f"signal {signum} received — flushing partial record")
         try:
@@ -262,6 +264,12 @@ def _compact_summary(record: dict) -> dict:
     per sub-bench, nothing nested deeper than one level."""
     s = {k: record.get(k) for k in ("metric", "value", "unit",
                                     "vs_baseline")}
+    from tpudl.testing import tsan as _tsan
+
+    # main() refuses to start armed, so this is always false on a
+    # judged line — recorded anyway so a stray TPUDL_TSAN=1 can never
+    # silently tax the numbers without showing on the record
+    s["tsan_armed"] = bool(_tsan.enabled())
     for k in ("headline_mode", "compute_dtype", "batch_size",
               "deadline_hit", "partial", "sigterm"):
         if k in record:
@@ -1811,6 +1819,15 @@ _V5E_PEAK_FLOPS = 197e12
 
 
 def main():
+    from tpudl.testing import tsan as _tsan
+
+    if _tsan.enabled():
+        # the sanitizer instruments every product lock — a judged
+        # round under TPUDL_TSAN=1 would silently tax the numbers.
+        # Refuse loudly instead of benching slow (CONCURRENCY.md).
+        print("bench: refusing to run judged rounds with the lock "
+              "sanitizer armed (unset TPUDL_TSAN)", file=sys.stderr)
+        raise SystemExit(1)
     dtype = os.environ.get("TPUDL_BENCH_DTYPE", "bfloat16")
     log(f"compute dtype: {dtype} (standard TPU inference precision; "
         "set TPUDL_BENCH_DTYPE=float32 for full-precision numbers)")

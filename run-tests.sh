@@ -5,8 +5,19 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== tpudl-check (AST invariant linter, ANALYSIS.md) =="
+echo "== tpudl-check (AST invariant linter, ANALYSIS.md + CONCURRENCY.md) =="
 python -m tools.tpudl_check tpudl tools bench.py
+
+echo "== tsan pass (lock sanitizer armed over the concurrency subset) =="
+# exit reports go to a scratch dir, not the checkout. Target the
+# concurrency module DIRECTLY: collecting all of tests/ drags in
+# modules whose imports fail on older jax (collection errors make
+# pytest exit 1 even with --continue-on-collection-errors, and set -e
+# would kill the whole gate before the main suite runs). User args go
+# FIRST: pytest keeps the last -m, so a caller's -m (e.g. 'not slow')
+# must not replace the concurrency marker and run everything armed.
+TPUDL_TSAN=1 TPUDL_FLIGHT_DIR="$(mktemp -d)" \
+    python -m pytest tests/test_concurrency.py -q "$@" -m concurrency
 
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"

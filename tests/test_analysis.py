@@ -616,7 +616,10 @@ class TestSuppressionContract:
         assert set(RULES) == {
             "hot-sync", "atomic-write", "signal-handler", "adhoc-retry",
             "swallowed-except", "undeclared-knob", "undeclared-metric",
-            "unlocked-global"}
+            "unlocked-global",
+            # the interprocedural concurrency rules (CONCURRENCY.md)
+            "lock-order", "lock-held-blocking", "signal-lock",
+            "daemon-shared-write"}
         for rule, desc in RULES.items():
             assert desc, rule
 

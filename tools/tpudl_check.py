@@ -8,18 +8,32 @@ importable (``from tpudl_check import run_check``) and runnable
 validators check emitted ARTIFACTS, this checks the SOURCE for the
 invariants those artifacts assume — atomic writes, flag-only signal
 handlers, the shared RetryPolicy, no hot-path syncs, no swallowed
-excepts, and schema-stable knob/metric names (ANALYSIS.md).
+excepts, and schema-stable knob/metric names (ANALYSIS.md) — plus the
+four INTERPROCEDURAL concurrency rules over the whole-tree lock graph
+(lock-order, lock-held-blocking, signal-lock, daemon-shared-write;
+CONCURRENCY.md).
 
 Exit codes (the validator convention): 0 clean, 2 findings, 1 error
-(unparseable file / bad usage).
+(unparseable file / bad usage / unknown rule id).
 
-``--list-rules`` prints the rule table; ``--registry-audit`` prints the
-declared-vs-used delta for the knob/metric registries (the round-trip
-tests/test_analysis.py enforces) and exits 2 when they drift.
+Flags:
+
+- ``--list-rules`` prints the rule table (per-file + concurrency);
+- ``--rules a,b,c`` runs only the named rules (an unknown id is rc 1,
+  the suppression-typo contract: a typo must not silently gate
+  nothing);
+- ``--json`` emits findings as one JSON object on stdout
+  (``{"files": N, "findings": [{file,line,rule,message,hint}],
+  "errors": [...]}``) so the sanitizer tests and future tooling can
+  diff findings machine-readably;
+- ``--registry-audit`` prints the declared-vs-used delta for the
+  knob/metric registries (the round-trip tests/test_analysis.py
+  enforces) and exits 2 when they drift.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -30,16 +44,44 @@ if _REPO not in sys.path:  # `python tools/tpudl_check.py` from anywhere
 
 from tpudl.analysis import (RULES, check_paths, collect_usage,  # noqa: E402
                             is_declared_metric, iter_python_files,
+                            CONCURRENCY_RULES, analyze_sources,
                             KNOB_NAMES, METRIC_NAMES, METRIC_PATTERNS)
+from tpudl.analysis.concurrency import read_sources  # noqa: E402
 from tpudl.analysis.metric_names import matches_pattern_prefix  # noqa: E402
 
 USAGE = ("usage: tpudl_check.py [--list-rules] [--registry-audit] "
-         "<path> [path ...]")
+         "[--rules <csv>] [--json] <path> [path ...]")
+
+def collect_findings(paths, root: str = ".", rules=None):
+    """(findings, errors) across BOTH halves — the per-file rules and
+    the interprocedural concurrency rules — optionally restricted to
+    ``rules``. The one entry point the CLI and the tests share; the
+    tree is read ONCE and the source map fed to both halves."""
+    findings = []
+    rule_set = set(rules) if rules is not None else None
+    sources, modules, errors = read_sources(paths, root=root)
+    # the per-file half always runs: it carries the parse errors and
+    # the bad-suppression findings (a typo'd ignore must surface no
+    # matter which rules were selected); its rule findings are filtered
+    per_file, errs = check_paths(paths, root=root, sources=sources)
+    if rule_set is not None:
+        per_file = [f for f in per_file
+                    if f.rule in rule_set or f.rule == "bad-suppression"]
+    findings.extend(per_file)
+    errors.extend(e for e in errs if e not in errors)
+    if rule_set is None or rule_set & set(CONCURRENCY_RULES):
+        conc = analyze_sources(
+            sources, modules=modules,
+            rules=(rule_set & set(CONCURRENCY_RULES)
+                   if rule_set is not None else None))
+        findings.extend(conc)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors
 
 
-def run_check(paths, root: str = ".", out=sys.stderr):
+def run_check(paths, root: str = ".", out=sys.stderr, rules=None):
     """(findings, errors) with findings rendered to ``out``."""
-    findings, errors = check_paths(paths, root=root)
+    findings, errors = collect_findings(paths, root=root, rules=rules)
     for f in findings:
         print(f.render(), file=out)
     for e in errors:
@@ -76,11 +118,35 @@ def main(argv) -> int:
     args = list(argv[1:])
     if "--list-rules" in args:
         for rule, desc in RULES.items():
-            print(f"{rule:20s} {desc}")
+            scope = ("interprocedural" if rule in CONCURRENCY_RULES
+                     else "per-file")
+            print(f"{rule:22s} [{scope}] {desc}")
         return 0
     audit = "--registry-audit" in args
     if audit:
         args.remove("--registry-audit")
+    as_json = "--json" in args
+    if as_json:
+        args.remove("--json")
+    rules = None
+    if "--rules" in args:
+        i = args.index("--rules")
+        if i + 1 >= len(args):
+            print("ERROR: --rules needs a comma-separated rule list",
+                  file=sys.stderr)
+            print(USAGE, file=sys.stderr)
+            return 1
+        rules = {r.strip() for r in args[i + 1].split(",") if r.strip()}
+        del args[i:i + 2]
+        unknown = rules - set(RULES)
+        if unknown or not rules:
+            # the suppression-typo contract: an unknown rule id must
+            # not silently run nothing and report clean
+            print(f"ERROR: unknown rule id(s) in --rules: "
+                  f"{sorted(unknown) or '(empty)'}", file=sys.stderr)
+            print("known rules: " + ", ".join(sorted(RULES)),
+                  file=sys.stderr)
+            return 1
     unknown_flags = [a for a in args if a.startswith("-")]
     if unknown_flags:
         # a typo'd --registry-adit must NOT silently run a plain lint
@@ -110,11 +176,23 @@ def main(argv) -> int:
             print(f"DRIFT: {line}", file=sys.stderr)
         print(f"registry audit: {'in sync' if not drift else str(len(drift)) + ' drift(s)'}")
         return 2 if drift else 0
-    findings, errors = run_check(paths)
-    dt = time.perf_counter() - t0
-    n_files = len(iter_python_files(paths))
-    print(f"tpudl-check: {n_files} files, {len(findings)} finding(s), "
-          f"{len(errors)} error(s) in {dt:.2f}s")
+    if as_json:
+        findings, errors = collect_findings(paths, rules=rules)
+        print(json.dumps({
+            "schema": "tpudl-check-findings",
+            "files": len(iter_python_files(paths)),
+            "rules": sorted(rules) if rules is not None else sorted(RULES),
+            "findings": [{"file": f.path, "line": f.line, "col": f.col,
+                          "rule": f.rule, "message": f.message,
+                          "hint": f.hint} for f in findings],
+            "errors": errors,
+        }, indent=1))
+    else:
+        findings, errors = run_check(paths, rules=rules)
+        dt = time.perf_counter() - t0
+        n_files = len(iter_python_files(paths))
+        print(f"tpudl-check: {n_files} files, {len(findings)} finding(s), "
+              f"{len(errors)} error(s) in {dt:.2f}s")
     if errors:
         return 1
     return 2 if findings else 0

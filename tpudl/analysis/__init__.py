@@ -10,16 +10,31 @@ Three pieces (ANALYSIS.md):
 - :mod:`tpudl.analysis.knobs`: the registry of every ``TPUDL_*`` env
   knob (the docs' knob tables render from it);
 - :mod:`tpudl.analysis.metric_names`: the registry of every
-  ``tpudl.obs`` metric name (shared with tools/validate_metrics.py).
+  ``tpudl.obs`` metric name (shared with tools/validate_metrics.py);
+- :mod:`tpudl.analysis.concurrency`: the INTERPROCEDURAL half
+  (CONCURRENCY.md) — the whole-tree lock graph and the four
+  concurrency rules (lock-order, lock-held-blocking, signal-lock,
+  daemon-shared-write);
+- :mod:`tpudl.analysis.locks`: the registry of every product lock
+  (name / module / guards / declared rank) — feeds the lock graph,
+  the runtime sanitizer (:mod:`tpudl.testing.tsan`), and the
+  CONCURRENCY.md inventory table.
 
 CLI: ``python -m tools.tpudl_check tpudl tools bench.py``
-(exit 0 clean / 2 findings / 1 error). Wired into run-tests.sh and
-tier-1 via tests/test_analysis.py.
+(exit 0 clean / 2 findings / 1 error; ``--rules`` / ``--json`` for
+selective machine-readable runs). Wired into run-tests.sh and tier-1
+via tests/test_analysis.py + tests/test_concurrency.py.
 """
 
 from .checker import (Finding, RULES, check_file, check_paths,
                       check_source, collect_usage, iter_python_files)
+from .concurrency import (CONCURRENCY_RULES, LockGraph, LockSite,
+                          analyze as analyze_concurrency,
+                          analyze_sources, build_lock_graph,
+                          registry_coverage)
 from .knobs import KNOBS, KNOB_NAMES, Knob, render_knob_table
+from .locks import (LOCKS, LOCK_NAMES, LockDecl, lock_order,
+                    render_lock_table)
 from .metric_names import (METRIC_NAMES, METRIC_PATTERNS, METRICS,
                            Metric, is_declared_metric,
                            render_metric_table, unknown_metric_names)
@@ -27,7 +42,12 @@ from .metric_names import (METRIC_NAMES, METRIC_PATTERNS, METRICS,
 __all__ = [
     "Finding", "RULES", "check_file", "check_paths", "check_source",
     "collect_usage", "iter_python_files",
+    "CONCURRENCY_RULES", "LockGraph", "LockSite",
+    "analyze_concurrency", "analyze_sources", "build_lock_graph",
+    "registry_coverage",
     "Knob", "KNOBS", "KNOB_NAMES", "render_knob_table",
+    "LockDecl", "LOCKS", "LOCK_NAMES", "lock_order",
+    "render_lock_table",
     "Metric", "METRICS", "METRIC_NAMES", "METRIC_PATTERNS",
     "is_declared_metric", "render_metric_table",
     "unknown_metric_names",

@@ -58,6 +58,20 @@ RULES: dict[str, str] = {
                          "tpudl/analysis/metric_names.py",
     "unlocked-global": "module global rebound outside a lock in a "
                        "module that spawns threads",
+    # the four INTERPROCEDURAL rules (tpudl.analysis.concurrency —
+    # they reason over the whole tree at once; listed here so the
+    # suppression grammar and --list-rules see one catalog)
+    "lock-order": "cycle in the acquired-under lock graph (ABBA "
+                  "deadlock risk across any number of call hops)",
+    "lock-held-blocking": "lock held across a blocking operation "
+                          "(bounded-queue put / join / device sync / "
+                          "durable IO / subprocess / sleep), directly "
+                          "or through a callee",
+    "signal-lock": "lock acquisition interprocedurally reachable from "
+                   "a signal.signal-registered handler",
+    "daemon-shared-write": "attribute/global written from both a "
+                           "thread-reachable function and foreground "
+                           "code with no common lock",
 }
 
 _HINTS: dict[str, str] = {
@@ -78,6 +92,17 @@ _HINTS: dict[str, str] = {
                          "tpudl/analysis/metric_names.py",
     "unlocked-global": "guard the write with the module's lock, or use "
                        "a bounded thread-safe structure",
+    "lock-order": "acquire in registry rank order (tpudl/analysis/"
+                  "locks.py; CONCURRENCY.md) — release the outer lock "
+                  "first, or merge the critical sections",
+    "lock-held-blocking": "move the blocking call outside the with "
+                          "block (snapshot under the lock, do the slow "
+                          "work after release)",
+    "signal-lock": "signal handlers set flags only (JOBS.md): do the "
+                   "locked work at the next boundary on a normal "
+                   "thread",
+    "daemon-shared-write": "take the structure's named_lock at BOTH "
+                           "write sites, or make one side copy-on-read",
 }
 
 _KNOB_RE = re.compile(r"TPUDL_[A-Z0-9_]+\Z")
@@ -686,12 +711,22 @@ def iter_python_files(paths) -> list[str]:
     return sorted(set(out))
 
 
-def check_paths(paths, root: str = ".") -> tuple[list[Finding],
-                                                 list[str]]:
+def check_paths(paths, root: str = ".",
+                sources: dict | None = None) -> tuple[list[Finding],
+                                                      list[str]]:
     """(findings, errors) over files/dirs. Errors are unreadable or
-    unparseable files — the CLI maps them to exit 1."""
+    unparseable files — the CLI maps them to exit 1. Pass ``sources``
+    (``{relpath: src}``, already read) to skip the file IO — the CLI
+    reads the tree once and feeds both checker halves."""
     findings: list[Finding] = []
     errors: list[str] = []
+    if sources is not None:
+        for rel, src in sorted(sources.items()):
+            try:
+                findings.extend(_FileChecker(src, rel, rel).run())
+            except _ParseError as e:
+                errors.append(str(e))
+        return findings, errors
     for path in iter_python_files(paths):
         try:
             findings.extend(check_file(path, root=root))

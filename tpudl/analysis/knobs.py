@@ -114,6 +114,13 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_FAULT_PLAN", "json", "", "jobs",
          "fault-injection plan JSON (tpudl.testing.faults), honored "
          "across process boundaries"),
+    Knob("TPUDL_TSAN", "bool", "0", "jobs",
+         "1 arms the runtime lock sanitizer (tpudl.testing.tsan): "
+         "named_lock() hands out instrumented locks, findings land in "
+         "tsan.* metrics + tpudl-tsan-<pid>.json (CONCURRENCY.md)"),
+    Knob("TPUDL_TSAN_DEADLOCK_S", "float", "10", "jobs",
+         "armed-acquisition wait slice before the sanitizer walks the "
+         "wait-for graph for a deadlock cycle"),
     # -- zoo / compile cache -------------------------------------------
     Knob("TPUDL_WEIGHTS_DIR", "path", "", "zoo",
          "offline pretrained-weights directory (<model>.npz artifacts)"),

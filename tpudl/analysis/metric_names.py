@@ -141,6 +141,14 @@ METRICS: tuple[Metric, ...] = (
     # -- obs self-metrics ----------------------------------------------
     Metric("obs.watchdog.stalls", "counter",
            "heartbeats flagged stalled (once per episode)"),
+    Metric("tsan.lock_order_inversions", "counter",
+           "armed sanitizer: observed ABBA inversions (once per edge "
+           "pair)"),
+    Metric("tsan.deadlocks", "counter",
+           "armed sanitizer: wait-for cycles / self-deadlocks detected"),
+    Metric("tsan.lockset_violations", "counter",
+           "armed sanitizer: registered structure mutated without its "
+           "declared guard lock"),
     Metric("obs.roofline.achieved_rows_per_s", "gauge",
            "measured end-to-end throughput (roofline input)"),
     Metric("obs.roofline.achievable_rows_per_s", "gauge",

@@ -32,11 +32,12 @@ process-wide default ``Frame.map_batches`` falls back to.
 from __future__ import annotations
 
 import os
-import threading
 import time
 import warnings
 
 import numpy as np
+
+from tpudl.testing import tsan as _tsan
 
 __all__ = [
     "CodecError",
@@ -255,7 +256,7 @@ class BF16Codec(WireCodec):
 
 
 _WIRE_MBPS_CACHE: dict = {}
-_WIRE_MBPS_LOCK = threading.Lock()
+_WIRE_MBPS_LOCK = _tsan.named_lock("data.codec.wire_probe")
 
 
 def probe_wire_mbps(mb: int = 4) -> float | None:
@@ -278,8 +279,14 @@ def probe_wire_mbps(mb: int = 4) -> float | None:
             import jax
 
             x = np.zeros(mb << 20, dtype=np.uint8)
+            # tpudl: ignore[lock-held-blocking] — the probe IS the
+            # blocking op: the lock serializes "one probe, ever", and
+            # concurrent probes would skew each other's timing (waiters
+            # get the cached result the moment it exists)
             jax.block_until_ready(jax.device_put(x[: 1 << 20]))  # warm
             t0 = time.perf_counter()
+            # tpudl: ignore[lock-held-blocking] — see above: the timed
+            # transfer must run under the probe lock
             jax.block_until_ready(jax.device_put(x))
             mbps = mb / (time.perf_counter() - t0)
         # tpudl: ignore[swallowed-except] — no backend / wedged RPC
@@ -413,7 +420,7 @@ class CodecPlan:
         self._deferred = base if isinstance(base, str) else None
         self._codecs: list[WireCodec | None] = [
             None if self._deferred else base for _ in range(n_cols)]
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("data.codec.plan")
         self._report = report
 
     # -- resolution --------------------------------------------------------

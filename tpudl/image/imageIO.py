@@ -25,6 +25,7 @@ from typing import Callable, Iterable
 import numpy as np
 
 from tpudl.frame.frame import LazyColumn
+from tpudl.testing import tsan as _tsan
 from tpudl.obs import metrics as _obs_metrics
 
 try:  # PIL is the decode substrate, mirroring the reference's Python path
@@ -477,7 +478,6 @@ class LazyFileColumn(LazyColumn):
                  probe: Callable | None = None,
                  io_workers: int | None = None,
                  decode_workers: int | None = None):
-        import threading
 
         self._paths = np.asarray(list(paths), dtype=object)
         self._transform = transform
@@ -485,9 +485,9 @@ class LazyFileColumn(LazyColumn):
         self._validity: np.ndarray | None = None
         self._memo: tuple[bytes, np.ndarray] | None = None
         self.reads = 0
-        self._reads_lock = threading.Lock()  # parallel batch reads
-        self._memo_lock = threading.Lock()   # concurrent _get callers
-        self._transform_lock = threading.Lock()  # serial-decode contract
+        self._reads_lock = _tsan.named_lock("image.lazyfile.reads")
+        self._memo_lock = _tsan.named_lock("image.lazyfile.memo")
+        self._transform_lock = _tsan.named_lock("image.lazyfile.transform")
         self.io_workers = int(io_workers if io_workers is not None
                               else _env_workers("TPUDL_FRAME_IO_WORKERS",
                                                 self._IO_WORKERS))

@@ -38,6 +38,7 @@ import threading
 import time
 
 from tpudl.jobs.spec import JobSpec
+from tpudl.testing import tsan as _tsan
 
 __all__ = ["JobRuntime", "JobContext", "JobPreempted", "RC_PREEMPTED",
            "MANIFEST_NAME", "MANIFEST_SCHEMA", "MANIFEST_VERSION",
@@ -222,7 +223,7 @@ class JobRuntime:
         self.spec = spec
         self._install_signals = bool(install_signals)
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("jobs.runtime.manifest")
         self._manifest: dict | None = None
         self._prev_sigterm = None
 

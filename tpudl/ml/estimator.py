@@ -25,7 +25,6 @@ from __future__ import annotations
 import math
 import os
 import tempfile
-import threading
 
 import numpy as np
 
@@ -37,6 +36,7 @@ from tpudl.ml.image_params import CanLoadImage
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
 from tpudl.obs import watchdog as _obs_watchdog
+from tpudl.testing import tsan as _tsan
 from tpudl.ml.keras_image import KerasImageFileTransformer
 from tpudl.ml.losses import get_loss, get_optimizer_dynamic
 from tpudl.ml.params import (HasInputCol, HasKerasLoss, HasKerasModel,
@@ -101,14 +101,14 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         # fitMultiple sweep (TrialScheduler.run's retry= contract; None
         # falls back to the TPUDL_HPO_TRIAL_ATTEMPTS env opt-in)
         self.trialRetryPolicy = trialRetryPolicy
-        self._save_lock = threading.Lock()  # shared keras write-back
+        self._save_lock = _tsan.named_lock("ml.estimator.save")
         # one compiled train step per (ingested graph, loss, optimizer),
         # shared across every trial (learning rate is dynamic in opt_state,
         # see losses.get_optimizer_dynamic) — N same-shape trials trace and
         # XLA-compile once per device slice, not once per trial. Shallow
         # Params.copy shares this dict, so trial copies hit the same cache.
         self._step_cache: dict = {}
-        self._step_lock = threading.Lock()
+        self._step_lock = _tsan.named_lock("ml.estimator.step_cache")
         kwargs = dict(self._input_kwargs)
         kwargs.pop("mesh", None)
         for k in ("prefetchDepth", "prepareWorkers", "fuseSteps",

@@ -21,7 +21,6 @@ once and compile once per distinct device slice.
 
 from __future__ import annotations
 
-import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, Iterator, Sequence
@@ -31,6 +30,7 @@ import jax
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
 from tpudl.obs import watchdog as _obs_watchdog
+from tpudl.testing import tsan as _tsan
 
 __all__ = ["TrialScheduler", "device_slices"]
 
@@ -94,7 +94,7 @@ class TrialScheduler:
         if self._max_parallel:
             slices = slices[: self._max_parallel]
         free = list(range(len(slices)))
-        free_lock = threading.Lock()
+        free_lock = _tsan.named_lock("ml.hpo.slices")
 
         def run_one(i, item):
             with free_lock:

@@ -13,9 +13,10 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from tpudl.testing import tsan as _tsan
 
 __all__ = ["available", "decode_resize_batch", "build", "lib_path"]
 
@@ -24,7 +25,7 @@ log = logging.getLogger("tpudl.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "decode.cpp")
 _LIB = os.path.join(_DIR, "libtpudl_decode.so")
-_lock = threading.Lock()
+_lock = _tsan.named_lock("native.build")
 _lib = None
 _build_failed = False
 
@@ -33,6 +34,9 @@ def lib_path() -> str:
     return _LIB
 
 
+# tpudl: ignore[lock-held-blocking] — the one-shot native build: the
+# lock EXISTS to hold everyone while one cc subprocess (timeout=120)
+# compiles; a second concurrent build would race the .so write
 def build(force: bool = False) -> bool:
     """Compile decode.cpp → libtpudl_decode.so. Returns success.
 

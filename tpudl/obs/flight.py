@@ -53,6 +53,8 @@ import traceback
 import zlib
 from collections import deque
 
+from tpudl.testing import tsan as _tsan
+
 __all__ = ["FlightRecorder", "get_recorder", "record_error",
            "record_batch", "dump", "install", "DUMP_SCHEMA",
            "DUMP_VERSION", "dump_path_for"]
@@ -158,7 +160,7 @@ class FlightRecorder:
     """Bounded in-memory black box + atomic gzip dump writer."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("obs.flight.recorder")
         self._batches: deque = deque(
             maxlen=max(1, _env_int("TPUDL_FLIGHT_BATCHES",
                                    _DEFAULT_BATCHES)))
@@ -195,6 +197,10 @@ class FlightRecorder:
         except Exception:
             return
         with self._lock:
+            if _tsan.ENABLED:
+                _tsan.check_guarded("obs.flight.recorder",
+                                    "flight-recorder batch ring",
+                                    lock=self._lock)
             self._batches.append(desc)
 
     def record_error(self, kind: str, error, **ctx):
@@ -434,11 +440,13 @@ class FlightRecorder:
             try:
                 prev = signal.getsignal(sig)
 
-                # tpudl: ignore[signal-handler] — THE forensics
-                # handler: dump() assembles on a bounded WORKER thread
-                # (timeout=10) so an interrupted frame holding an obs
-                # lock can't deadlock it, then chains/re-raises for
-                # default exit semantics
+                # tpudl: ignore[signal-handler, signal-lock] — THE
+                # forensics handler: dump() assembles on a bounded
+                # WORKER thread (timeout=10) so an interrupted frame
+                # holding an obs lock can't deadlock it (the worker,
+                # not the handler frame, takes the recorder/metrics/
+                # report locks), then chains/re-raises for default
+                # exit semantics
                 def handler(signum, frame, _prev=prev):
                     self.dump(reason=f"signal:{signum}", timeout=10.0)
                     if callable(_prev):

@@ -22,9 +22,10 @@ import atexit
 import contextlib
 import json
 import os
-import threading
 import time
 from collections import deque
+
+from tpudl.testing import tsan as _tsan
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "counter", "gauge", "histogram", "snapshot",
@@ -51,7 +52,7 @@ class Counter:
 
     def __init__(self):
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("obs.metrics.counter")
 
     def inc(self, amount: float = 1.0):
         a = float(amount)  # numpy scalars would poison the JSON sink
@@ -73,7 +74,7 @@ class Gauge:
         self.count = 0
         self.total = 0.0
         self.max = None
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("obs.metrics.gauge")
 
     def set(self, value: float):
         v = float(value)
@@ -107,7 +108,7 @@ class Histogram:
         self.total = 0.0
         self.min = None
         self.max = None
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("obs.metrics.histogram")
 
     def observe(self, value: float):
         v = float(value)
@@ -160,7 +161,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("obs.metrics.registry")
         self._next_flush = 0.0  # monotonic deadline; 0 = resolve lazily
         self._atexit_registered = False
 
@@ -168,6 +169,10 @@ class MetricsRegistry:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
+                if _tsan.ENABLED:
+                    _tsan.check_guarded("obs.metrics.registry",
+                                        "metrics registry name map",
+                                        lock=self._lock)
                 m = self._metrics[name] = cls(**kw)
                 self._register_atexit()
             elif not isinstance(m, cls):

@@ -18,12 +18,12 @@ from __future__ import annotations
 import contextlib
 import itertools
 import os
-import threading
 import time
 from collections import deque
 
 from tpudl.obs import metrics as _metrics
 from tpudl.obs import tracer as _tracer
+from tpudl.testing import tsan as _tsan
 
 __all__ = ["PipelineReport", "last_pipeline_report", "set_last_pipeline",
            "pipeline_reports", "get_pipeline_report"]
@@ -88,7 +88,7 @@ class PipelineReport:
         # any stage leaves "last progress = entering <stage>" as the
         # stall's suspect (tpudl.obs.watchdog)
         self.heartbeat = None
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("obs.pipeline.report")
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -206,7 +206,7 @@ def _ring_size() -> int:
 
 
 _REPORTS: deque = deque(maxlen=_ring_size())
-_REPORTS_LOCK = threading.Lock()
+_REPORTS_LOCK = _tsan.named_lock("obs.pipeline.ring")
 
 
 def set_last_pipeline(report: PipelineReport | None):
@@ -220,6 +220,10 @@ def set_last_pipeline(report: PipelineReport | None):
     if report is None:
         return
     with _REPORTS_LOCK:
+        if _tsan.ENABLED:
+            _tsan.check_guarded("obs.pipeline.ring",
+                                "pipeline-report ring",
+                                lock=_REPORTS_LOCK)
         _REPORTS.append(report)
 
 
@@ -239,8 +243,9 @@ def pipeline_reports() -> dict[str, dict]:
 
 def get_pipeline_report(run_id: str) -> dict | None:
     """One ring entry by run id (None once evicted)."""
+    # snapshot under the ring lock, render outside it — like the two
+    # accessors above (report() takes the report's own lock and does
+    # real work; holding the ring across it is needless contention)
     with _REPORTS_LOCK:
-        for r in _REPORTS:
-            if r.run_id == run_id:
-                return r.report()
-    return None
+        match = next((r for r in _REPORTS if r.run_id == run_id), None)
+    return match.report() if match is not None else None

@@ -32,6 +32,8 @@ import threading
 import time
 import traceback
 
+from tpudl.testing import tsan as _tsan
+
 __all__ = ["Heartbeat", "HeartbeatRegistry", "Watchdog", "get_registry",
            "heartbeat", "start_watchdog", "stop_watchdog",
            "thread_stacks"]
@@ -102,22 +104,34 @@ class Heartbeat:
         self.parent = parent
         self._registry = registry
         self._inflight: dict[str, list] = {}  # stage -> [count, t0]
-        self._iflock = threading.Lock()
+        # one lock per heartbeat covers the beat fields AND the
+        # in-flight stage map: the watchdog daemon and the status
+        # writer snapshot both while beat()/stage_enter() mutate them
+        self._iflock = _tsan.named_lock("obs.watchdog.heartbeat")
 
     def beat(self, **info):
         """Progress happened. ``info`` overlays the heartbeat's info
         (e.g. ``stage="prepare"``) so a later stall names the exact
-        stage that beat LAST; the parent chain is re-armed too."""
+        stage that beat LAST; the parent chain is re-armed too.
+
+        Guarded by ``_iflock``: the daemon and the status writer copy
+        ``info`` concurrently, and a dict mutated mid-copy raises
+        RuntimeError in the READER (tests/test_concurrency.py pins the
+        regression). Parent locks are taken one at a time AFTER
+        releasing our own — per-heartbeat locks share a rank and must
+        never nest (tpudl/analysis/locks.py)."""
         now = time.monotonic()
-        self.last_beat = now
-        self.beats += 1
-        self.stalled = False  # re-arm: one event per stall episode
-        if info:
-            self.info.update(info)
+        with self._iflock:
+            self.last_beat = now
+            self.beats += 1
+            self.stalled = False  # re-arm: one event per stall episode
+            if info:
+                self.info.update(info)
         p = self.parent
         while p is not None:  # child progress IS parent progress
-            p.last_beat = now
-            p.stalled = False
+            with p._iflock:
+                p.last_beat = now
+                p.stalled = False
             p = p.parent
 
     def stage_enter(self, stage: str):
@@ -145,21 +159,36 @@ class Heartbeat:
         the stall event's suspect material."""
         now = now if now is not None else time.monotonic()
         with self._iflock:
-            return {k: {"count": v[0], "age_s": round(now - v[1], 3)}
+            # a stage_enter() can land between the caller's `now` and
+            # this snapshot — clamp like describe(): never negative
+            return {k: {"count": v[0],
+                        "age_s": round(max(0.0, now - v[1]), 3)}
                     for k, v in self._inflight.items()}
 
     def age(self, now: float | None = None) -> float:
         return (now if now is not None else time.monotonic()) \
             - self.last_beat
 
+    def mark_stalled(self):
+        """Daemon-side: flag this heartbeat's stall episode (re-armed
+        by the next beat)."""
+        with self._iflock:
+            self.stalled = True
+
     def describe(self, now: float | None = None) -> dict:
-        return {"name": self.name, "info": dict(self.info),
-                "beats": self.beats, "age_s": round(self.age(now), 3),
-                "alive_s": round(
-                    (now if now is not None else time.monotonic())
-                    - self.started, 3),
-                "in_flight": self.inflight(now),
-                "stalled": self.stalled}
+        now = now if now is not None else time.monotonic()
+        with self._iflock:
+            # a beat can land between the caller's `now` and this
+            # snapshot — clamp: an age is never negative
+            snap = {"name": self.name, "info": dict(self.info),
+                    "beats": self.beats,
+                    "age_s": round(max(0.0, now - self.last_beat), 3),
+                    "alive_s": round(now - self.started, 3),
+                    "stalled": self.stalled}
+        # sequential second acquisition (inflight takes the same
+        # non-reentrant lock) — never nested
+        snap["in_flight"] = self.inflight(now)
+        return snap
 
     def __enter__(self) -> "Heartbeat":
         return self
@@ -175,7 +204,7 @@ class HeartbeatRegistry:
     see :class:`Heartbeat`)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("obs.watchdog.registry")
         self._active: set[Heartbeat] = set()
         self._tls = threading.local()
 
@@ -190,6 +219,10 @@ class HeartbeatRegistry:
         parent = stack[-1] if stack else None
         hb = Heartbeat(name, self, parent=parent, **info)
         with self._lock:
+            if _tsan.ENABLED:
+                _tsan.check_guarded("obs.watchdog.registry",
+                                    "heartbeat registry active set",
+                                    lock=self._lock)
             self._active.add(hb)
         stack.append(hb)
         return hb
@@ -278,12 +311,16 @@ class Watchdog:
         for hb in self.registry.active():
             if hb.stalled or hb.age(now) <= self.stall_s:
                 continue
-            hb.stalled = True  # one event per episode
+            hb.mark_stalled()  # one event per episode
+            # describe() snapshots info/beats/in_flight under the
+            # heartbeat's lock — reading the live dicts here raced
+            # beat()'s mutations (the Heartbeat.beat regression test)
+            desc = hb.describe(now)
             event = {"ts": time.time(), "name": hb.name,
-                     "info": dict(hb.info), "beats": hb.beats,
-                     "age_s": round(hb.age(now), 3),
+                     "info": desc["info"], "beats": desc["beats"],
+                     "age_s": desc["age_s"],
                      "stall_s": self.stall_s,
-                     "in_flight": hb.inflight(now),
+                     "in_flight": desc["in_flight"],
                      "active": sorted(h.name
                                       for h in self.registry.active()),
                      "stacks": thread_stacks()}
@@ -293,14 +330,15 @@ class Watchdog:
             log.warning(
                 "watchdog: %r made no progress for %.1fs (> %.1fs) — "
                 "last info %s; thread stacks recorded in the flight "
-                "recorder", hb.name, hb.age(now), self.stall_s, hb.info)
+                "recorder", hb.name, desc["age_s"], self.stall_s,
+                desc["info"])
         _flight.get_recorder().record_metrics_tick()
         return flagged
 
 
 _REGISTRY = HeartbeatRegistry()
 _WATCHDOG: Watchdog | None = None
-_WATCHDOG_LOCK = threading.Lock()
+_WATCHDOG_LOCK = _tsan.named_lock("obs.watchdog.daemon")
 
 
 def get_registry() -> HeartbeatRegistry:
@@ -352,5 +390,9 @@ def stop_watchdog():
     global _WATCHDOG
     with _WATCHDOG_LOCK:
         if _WATCHDOG is not None:
+            # tpudl: ignore[lock-held-blocking] — may-analysis:
+            # name-based resolution maps .stop() onto StatusWriter.stop
+            # too; this receiver is a Watchdog, whose stop() joins the
+            # daemon with timeout=2.0 and touches no device path
             _WATCHDOG.stop()
             _WATCHDOG = None

@@ -36,7 +36,8 @@ import builtins
 import json
 import os
 import signal
-import threading
+
+from tpudl.testing import tsan as _tsan
 
 __all__ = ["FaultPlan", "FaultInjected", "arm", "disarm", "fire",
            "install_from_env", "PLAN_ENV"]
@@ -44,7 +45,7 @@ __all__ = ["FaultPlan", "FaultInjected", "arm", "disarm", "fire",
 PLAN_ENV = "TPUDL_FAULT_PLAN"
 
 _PLAN: "FaultPlan | None" = None
-_ARM_LOCK = threading.Lock()
+_ARM_LOCK = _tsan.named_lock("testing.faults.arm")
 
 
 class FaultInjected(RuntimeError):
@@ -108,7 +109,7 @@ class FaultPlan:
     """A deterministic set of fault rules, armed process-globally."""
 
     def __init__(self, rules):
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("testing.faults.plan")
         self.rules = [r if isinstance(r, _Rule) else _Rule(dict(r))
                       for r in rules]
         self.fired: list[dict] = []  # every TRIGGERED fault, for asserts

@@ -50,6 +50,7 @@ import numpy as np
 # the ONE chunked-crc32 helper (tools/validate_job.py keeps its own
 # copy on purpose: validators stay stdlib-pure, importing no tpudl)
 from tpudl.data.shards import _crc32_file
+from tpudl.testing import tsan as _tsan
 
 __all__ = ["CheckpointManager", "CheckpointCorruption", "as_numpy_state"]
 
@@ -102,7 +103,7 @@ class CheckpointManager:
         os.makedirs(self._dir, exist_ok=True)
         self.save_every = int(save_every)
         self.max_to_keep = int(max_to_keep)
-        self._lock = threading.Lock()
+        self._lock = _tsan.named_lock("train.checkpoint.manifest")
         self._manifest: dict[str, dict] = {}
         self._load_manifest()
 
