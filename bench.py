@@ -324,6 +324,12 @@ def _compact_summary(record: dict) -> dict:
             # the tpudl.data one-line evidence: u8 ships ~4x fewer
             # bytes; a warm epoch reads ZERO files
             s[k] = _scalar(dp[k])
+    ad = record.get("async_dispatch") or {}
+    for k in ("async_speedup", "dispatch_overlap_pct"):
+        if ad.get(k) is not None:
+            # the ROADMAP-2 one-liners: depth-D over blocking, and how
+            # much of the dispatch round-trip the window actually hid
+            s[k] = _scalar(ad[k])
     pre = record.get("preemption") or {}
     if pre.get("graceful_kill_rc") is not None:
         # the robustness one-liners (JOBS.md): graceful kill exits 75,
@@ -1403,6 +1409,70 @@ def measure_data_pipeline():
     return out
 
 
+def measure_async_dispatch():
+    """async-dispatch A/B sub-bench (PIPELINE.md "Async dispatch"): the
+    SAME jitted featurize-shaped reduction over the SAME frame, blocking
+    executor (dispatch_depth=1, autotune off — the pre-ISSUE-10
+    dispatch loop) vs the D-deep in-flight window, trials interleaved so
+    tunnel weather hits both arms alike. Emits ``async_speedup``
+    (depth-D over blocking, the ROADMAP-2 headline) and
+    ``dispatch_overlap_pct`` (share of pool dispatch seconds the window
+    actually hid, off the PipelineReport's ``dispatch_overlap_s``) onto
+    the judged summary line; bench_sentinel bands both, so an overlap
+    regression flags like the wire metrics."""
+    import jax
+
+    from tpudl import obs
+    from tpudl.frame import Frame
+
+    n = int(os.environ.get("TPUDL_BENCH_ASYNC_N", "768"))
+    depth = max(2, int(os.environ.get("TPUDL_BENCH_ASYNC_DEPTH", "4")))
+    batch = 64
+    h = w = 64
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(n, h, w, 3)).astype(np.float32)
+    frame = Frame({"x": x})
+    # dispatch-latency-shaped on purpose: light compute, small outputs —
+    # the arm difference is the per-dispatch round-trip the window hides
+    fn = jax.jit(lambda b: b.reshape(b.shape[0], -1).mean(axis=1))
+    out = {"n": n, "batch": batch, "dispatch_depth": depth}
+
+    def one_pass(d):
+        t0 = time.perf_counter()
+        res = frame.map_batches(fn, ["x"], ["y"], batch_size=batch,
+                                dispatch_depth=d, fuse_steps=1,
+                                autotune=False)
+        np.asarray(res["y"])  # materialized
+        rate = n / (time.perf_counter() - t0)
+        return rate, obs.last_pipeline_report()
+
+    for d in (1, depth):  # compile + warm both arms outside timing
+        one_pass(d)
+    arms = {1: [], depth: []}
+    overlaps = []
+    for _t in range(3):
+        for d in (1, depth):
+            rate, rep = one_pass(d)
+            arms[d].append(rate)
+            if d > 1 and rep:
+                tot = (rep.get("stage_seconds") or {}).get("dispatch", 0)
+                ov = rep.get("dispatch_overlap_s")
+                if tot and ov is not None:
+                    overlaps.append(100.0 * ov / tot)
+    med = {d: statistics.median(r) for d, r in arms.items()}
+    out["blocking_images_per_sec"] = round(med[1], 1)
+    out["async_images_per_sec"] = round(med[depth], 1)
+    if med[1] > 0:
+        out["async_speedup"] = round(med[depth] / med[1], 2)
+    out["dispatch_overlap_pct"] = (round(statistics.median(overlaps), 1)
+                                   if overlaps else None)
+    log(f"async dispatch A/B: blocking {out['blocking_images_per_sec']} "
+        f"vs depth-{depth} {out['async_images_per_sec']} img/s -> "
+        f"{out.get('async_speedup')}x "
+        f"(overlap {out['dispatch_overlap_pct']}%)")
+    return out
+
+
 def run_preemption_job(workdir, out_path, steps, save_every,
                        progress_path):
     """Subprocess body of the preemption sub-bench (``bench.py
@@ -1977,7 +2047,8 @@ def main():
         # so round-over-round swings in these rows are attributable to
         # tunnel weather INSIDE the same record
         probed = {"horovod_resnet50", "predictor_resnet50",
-                  "estimator_inception", "data_pipeline"}
+                  "estimator_inception", "data_pipeline",
+                  "async_dispatch"}
         for key, fn in [("horovod_resnet50", lambda: measure_train_step(dtype)),
                         ("predictor_resnet50", lambda: measure_predictor(dtype)),
                         ("keras_transformer_mlp", measure_keras_transformer),
@@ -1985,6 +2056,7 @@ def main():
                         ("estimator_inception", measure_estimator_inception),
                         ("decode", measure_decode),
                         ("data_pipeline", measure_data_pipeline),
+                        ("async_dispatch", measure_async_dispatch),
                         ("preemption", measure_preemption),
                         ("flash_attention", measure_flash_attention)]:
             if not _gate(extra, key):

@@ -89,6 +89,55 @@ class TestHotSync:
             "    return a, b\n")
         assert [f.line for f in only(src, "hot-sync")] == [3, 4]
 
+    def test_future_result_in_dispatch_stage_fires(self):
+        """ISSUE 10: a bare .result() on an in-flight future inside
+        ``report.stage('dispatch')`` blocks the dispatch loop exactly
+        like block_until_ready — the async-window helpers must wait in
+        their own (non-hot) dispatch_wait stage instead."""
+        src = (
+            "def run(report, futs):\n"
+            "    with report.stage('dispatch'):\n"
+            "        out = futs.popleft().result()\n"
+            "    return out\n")
+        fs = only(src, "hot-sync")
+        assert len(fs) == 1 and fs[0].line == 3
+        assert ".result()" in fs[0].message
+
+    def test_future_wait_in_hot_marked_fn_fires(self):
+        src = (
+            "def drain(fut):  # tpudl: hot-path\n"
+            "    fut.wait()\n")
+        fs = only(src, "hot-sync")
+        assert len(fs) == 1 and ".wait()" in fs[0].message
+
+    def test_result_in_dispatch_wait_stage_is_clean(self):
+        """The executor's own window wait lives in ``dispatch_wait`` —
+        deliberately NOT a hot stage (it IS the accounted residue)."""
+        src = (
+            "def pop(report, futs):\n"
+            "    with report.stage('dispatch_wait'):\n"
+            "        return futs.popleft().result()\n")
+        assert only(src, "hot-sync") == []
+
+    def test_result_with_timeout_arg_is_clean(self):
+        """.result(timeout)/.wait(timeout) are bounded probes, not the
+        unbounded block the rule targets."""
+        src = (
+            "def run(report, fut, ev):\n"
+            "    with report.stage('dispatch'):\n"
+            "        a = fut.result(5.0)\n"
+            "        b = ev.wait(timeout=1.0)\n"
+            "    return a, b\n")
+        assert only(src, "hot-sync") == []
+
+    def test_result_suppressible_with_reason(self):
+        src = (
+            "def run(report, fut):\n"
+            "    with report.stage('dispatch'):\n"
+            "        return fut.result()  "
+            "# tpudl: ignore[hot-sync] — drain IS this stage's point\n")
+        assert only(src, "hot-sync") == []
+
     def test_cold_function_is_clean(self):
         src = (
             "import numpy as np\n"

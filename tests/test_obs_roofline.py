@@ -64,30 +64,89 @@ class TestRound45Attribution:
         assert 0.95 <= total <= 1.01
         assert all(0.0 <= v <= 1.0 for v in rr.gap_attribution.values())
 
-    def test_advisor_recommends_fuse_steps_with_gain(self):
+    def test_dispatch_depth_is_top_recommendation(self):
+        """ISSUE 10: on the dispatch-bound round-4/5 shape the async
+        in-flight window is THE recommendation — it overlaps the
+        round-trips (and the d2h drain) without recompiling, so it must
+        outrank fusion; fuse_steps rides second (the two compose)."""
         rr = roofline.analyze(round45_report(), h2d_mbps=WIRE_MBPS,
                               device_ms_per_dispatch=DEVICE_MS,
                               publish=False)
         assert rr.advice, "dispatch-bound run must produce advice"
         top = rr.advice[0]
-        assert top["knob"] == "fuse_steps"
+        assert top["knob"] == "dispatch_depth"
         assert top["recommended"] > top["current"] == 1
-        assert top["recommended"] <= roofline.KNOB_CAPS["fuse_steps"]
+        assert top["recommended"] <= roofline.KNOB_CAPS["dispatch_depth"]
         assert top["predicted_gain_pct"] > 20
-        assert "fuse_steps" in rr.verdict and "dispatch" in rr.verdict
+        assert "dispatch_depth" in rr.verdict and "dispatch" in rr.verdict
+
+    def test_advisor_recommends_fuse_steps_with_gain(self):
+        rr = roofline.analyze(round45_report(), h2d_mbps=WIRE_MBPS,
+                              device_ms_per_dispatch=DEVICE_MS,
+                              publish=False)
+        fuse = next(r for r in rr.advice if r["knob"] == "fuse_steps")
+        assert fuse["recommended"] > fuse["current"] == 1
+        assert fuse["recommended"] <= roofline.KNOB_CAPS["fuse_steps"]
+        assert fuse["predicted_gain_pct"] > 20
 
     def test_verdict_consumable_by_async_executor(self):
         """The ROADMAP-2 contract: the advice entries carry exactly the
         knob names map_batches accepts, as numbers (or codec strings)
-        — directly settable, no parsing."""
+        — directly settable, no parsing (the autotuner consumes
+        fuse_steps/dispatch_depth/prefetch_depth verbatim)."""
         rr = roofline.analyze(round45_report(), h2d_mbps=WIRE_MBPS,
                               device_ms_per_dispatch=DEVICE_MS,
                               publish=False)
-        valid = {"fuse_steps", "prefetch_depth", "prepare_workers",
-                 "wire_codec"}
+        valid = {"fuse_steps", "dispatch_depth", "prefetch_depth",
+                 "prepare_workers", "wire_codec"}
         for rec in rr.advice:
             assert rec["knob"] in valid
             assert "recommended" in rec and "predicted_gain_pct" in rec
+
+    def test_autotune_seed_matches_advice(self):
+        """autotune_seed() returns exactly the advisor's recommended
+        numbers for the executor-seedable knobs, capped."""
+        rep = round45_report()
+        rr = roofline.analyze(rep, h2d_mbps=WIRE_MBPS,
+                              device_ms_per_dispatch=DEVICE_MS,
+                              publish=False)
+        advice = {r["knob"]: r["recommended"] for r in rr.advice}
+        import os
+
+        os.environ["TPUDL_WIRE_MBPS"] = str(WIRE_MBPS)
+        os.environ["TPUDL_DEVICE_MS_PER_STEP"] = str(DEVICE_MS)
+        try:
+            seeds = roofline.autotune_seed(rep)
+        finally:
+            del os.environ["TPUDL_WIRE_MBPS"]
+            del os.environ["TPUDL_DEVICE_MS_PER_STEP"]
+        assert seeds["dispatch_depth"] == advice["dispatch_depth"]
+        assert seeds["fuse_steps"] == advice["fuse_steps"]
+        assert set(seeds) <= set(roofline.AUTOTUNE_KNOBS)
+        for k, v in seeds.items():
+            assert v <= roofline.KNOB_CAPS[k]
+
+    def test_async_report_attributes_dispatch_wait_not_pool_sum(self):
+        """A report from the async executor carries pool-summed
+        ``dispatch`` seconds (can exceed wall) plus the consumer's
+        ``dispatch_wait``: the model must attribute the WAIT — the
+        unhidden residue — not re-charge time the window already hid."""
+        rep = round45_report(
+            wall_seconds=0.8,
+            stage_seconds={"prepare": 1.5, "infeed_wait": 0.05,
+                           "dispatch": 1.9,       # pool-summed
+                           "dispatch_wait": 0.25,  # consumer residue
+                           "d2h": 0.05},
+            dispatch_depth=8)
+        rr = roofline.analyze(rep, h2d_mbps=10_000.0,
+                              device_ms_per_dispatch=DEVICE_MS,
+                              publish=False)
+        assert rr is not None
+        # residue ≈ 0.25 - 0.137 compute; never the pool-summed 1.9
+        assert rr.dispatch_overhead_s <= 0.25
+        assert rr.inputs["dispatch_depth"] == 8
+        total = sum(rr.gap_attribution.values())
+        assert total <= 1.0001
 
 
 class TestOtherBottlenecks:
@@ -253,4 +312,4 @@ class TestGaugesAndIntegration:
                               publish=False)
         d = json.loads(json.dumps(rr.to_dict()))
         assert d["bottleneck"] == "dispatch"
-        assert d["advice"][0]["knob"] == "fuse_steps"
+        assert d["advice"][0]["knob"] == "dispatch_depth"

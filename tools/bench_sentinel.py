@@ -131,6 +131,19 @@ METRICS = [
                           + r" img/s",
                           r'"device_images_per_sec": ' + _NUM],
            wire_sensitive=False, floor=0.05),
+    # async-dispatch A/B: both are within-round ratios (depth-D over
+    # blocking; share of dispatch seconds the window hid), so the wire
+    # largely cancels — scored raw with a moderate band. A drop here is
+    # the in-flight window failing to overlap round-trips: an executor
+    # regression, flagged like the wire metrics
+    Metric("async_speedup",
+           keys=[("async_dispatch", "async_speedup")],
+           tail_patterns=[r'"async_speedup": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
+    Metric("dispatch_overlap_pct",
+           keys=[("async_dispatch", "dispatch_overlap_pct")],
+           tail_patterns=[r'"dispatch_overlap_pct": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
     # host-side stages: no wire in the loop
     Metric("decode_native_images_per_sec",
            keys=[("decode", "native_images_per_sec")],

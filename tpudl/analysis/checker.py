@@ -43,7 +43,8 @@ __all__ = ["Finding", "RULES", "check_source", "check_file",
 
 RULES: dict[str, str] = {
     "hot-sync": "blocking device sync (block_until_ready/.item()/"
-                "np.asarray/jax.device_get) on the executor hot path",
+                "np.asarray/jax.device_get/bare .result()/.wait() on "
+                "an in-flight future) on the executor hot path",
     "atomic-write": "durable artifact opened for write without the "
                     "tmp + os.replace idiom in the same function",
     "signal-handler": "signal handler does more than set flags / "
@@ -516,6 +517,15 @@ class _FileChecker:
             bad = "block_until_ready"
         elif name == "item" and not node.args and not node.keywords:
             bad = ".item()"
+        elif name in ("result", "wait") and \
+                isinstance(node.func, ast.Attribute) and \
+                not node.args and not node.keywords:
+            # the async-dispatch window's helpers: a bare .result() /
+            # .wait() on an in-flight future inside a hot stage blocks
+            # the dispatch loop exactly like block_until_ready (the
+            # executor's own window waits live in their own
+            # ``dispatch_wait`` stage, which is deliberately NOT hot)
+            bad = f".{name}() (in-flight future)"
         elif dotted in ("jax.device_get",):
             bad = "jax.device_get"
         elif dotted in ("np.asarray", "np.array", "numpy.asarray",

@@ -46,6 +46,15 @@ KNOBS: tuple[Knob, ...] = (
          "prepare-pool threads packing/decoding batches concurrently"),
     Knob("TPUDL_FRAME_FUSE_STEPS", "int", "1", "frame",
          "microbatches per compiled lax.scan dispatch (1 = off)"),
+    Knob("TPUDL_FRAME_DISPATCH_DEPTH", "int", "2", "frame",
+         "async dispatch window: in-flight dispatches kept as futures "
+         "(1 = blocking dispatch)"),
+    Knob("TPUDL_FRAME_DONATE", "bool", "1", "frame",
+         "donate input buffers on the fused/codec-wrapped dispatch "
+         "paths (0 = off)"),
+    Knob("TPUDL_FRAME_AUTOTUNE", "bool", "1", "frame",
+         "seed unset fuse_steps/dispatch_depth/prefetch_depth from the "
+         "roofline advisor's recommendations (0 = off)"),
     Knob("TPUDL_FRAME_IO_WORKERS", "int", "8", "frame",
          "LazyFileColumn file-read threads"),
     Knob("TPUDL_FRAME_DECODE_WORKERS", "int", "1", "frame",
@@ -186,6 +195,10 @@ KNOBS: tuple[Knob, ...] = (
          "data-pipeline sub-bench row count"),
     Knob("TPUDL_BENCH_DATA_FILES", "int", "192", "bench",
          "data-pipeline cache sub-bench file count"),
+    Knob("TPUDL_BENCH_ASYNC_N", "int", "768", "bench",
+         "async-dispatch A/B sub-bench row count"),
+    Knob("TPUDL_BENCH_ASYNC_DEPTH", "int", "4", "bench",
+         "async-dispatch A/B sub-bench depth-D arm window size"),
     Knob("TPUDL_BENCH_FLASH_SEQS", "str", "2048,4096,8192,16384",
          "bench", "flash-attention sub-bench sequence-length ladder"),
     Knob("TPUDL_BENCH_PREEMPT_STEPS", "int", "300", "bench",

@@ -53,8 +53,17 @@ METRICS: tuple[Metric, ...] = (
            "(prepare/h2d/dispatch/d2h/infeed_wait)"),
     Metric("frame.overlap_efficiency", "gauge",
            "1 - infeed_wait/prepare for the last run"),
+    Metric("frame.dispatch.inflight", "gauge",
+           "mean in-flight dispatch-window occupancy of the last "
+           "async run"),
+    Metric("frame.dispatch.overlap_s", "gauge",
+           "dispatch seconds the in-flight window hid from the "
+           "consumer (last async run)"),
     Metric("queue_depth", "report-gauge",
            "infeed queue depth sampled per batch (PipelineReport)"),
+    Metric("dispatch_inflight", "report-gauge",
+           "in-flight dispatches sampled per submit (PipelineReport; "
+           "max can never exceed dispatch_depth)"),
     Metric("wire_batch_bytes", "report-gauge",
            "bytes shipped per batch (PipelineReport)"),
     # -- data: codecs + shard cache ------------------------------------

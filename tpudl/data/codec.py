@@ -42,6 +42,7 @@ from tpudl.testing import tsan as _tsan
 __all__ = [
     "CodecError",
     "WireCodec",
+    "filter_unusable_donation_warning",
     "IdentityCodec",
     "U8Codec",
     "BF16Codec",
@@ -376,6 +377,26 @@ def spec_token(spec) -> str:
     return str(spec)
 
 
+_DONATION_WARNING_MSG = "Some donated buffers were not usable"
+
+
+def filter_unusable_donation_warning():
+    """XLA warns (once per compile) when a donated buffer cannot be
+    reused — routine on codec paths whose encoded inputs are smaller
+    than any output (a u8 wire buffer can never alias an f32 feature
+    map), and harmless: an unusable donation is simply ignored. The
+    executor owns every donating jit it builds, so it installs ONE
+    message-anchored ignore when a donating wrapper is built. The
+    presence check keeps ``warnings.filters`` from growing a duplicate
+    entry per program (and re-installs after a test harness restored
+    the filter state, where a module latch would go stale)."""
+    for f in warnings.filters:
+        if f[0] == "ignore" and f[1] is not None \
+                and getattr(f[1], "pattern", None) == _DONATION_WARNING_MSG:
+            return
+    warnings.filterwarnings("ignore", message=_DONATION_WARNING_MSG)
+
+
 _warned_host_codec = False
 
 
@@ -497,27 +518,44 @@ class CodecPlan:
             self._report.gauge("wire_batch_bytes", shipped)
 
     # -- device side -------------------------------------------------------
-    def wrap(self, fn):
+    def wrap(self, fn, donate: bool = False):
         """``fn`` with the per-column prologues fused in front, as ONE
         jitted program. Identity-only plans return ``fn`` untouched (no
-        extra jit layer, bit-for-bit today's path). The wrapper is
-        cached on ``fn`` itself keyed by the resolved codec keys, so
-        repeated transforms reuse one compiled program."""
+        extra jit layer, bit-for-bit today's path — which also means no
+        donation: the executor never re-jits a user's fn just to carry
+        ``donate_argnums``). With ``donate=True`` every wire input is
+        donated (``jax.jit(..., donate_argnums=...)``): XLA may reuse
+        the staged buffers for outputs/temps so steady-state dispatch
+        allocates nothing extra. Donation changes no values (the u8
+        atol=0 restore guarantee is pinned donation-on and -off); a
+        donated buffer that cannot alias any output (a u8 wire batch
+        restoring to f32) is simply ignored by XLA. The caller
+        (Frame.map_batches) hands donating programs writable COPIES of
+        shard-cache hits, never the cache's read-only mmap. The wrapper
+        is cached on ``fn`` itself keyed by the resolved codec keys +
+        the donate flag, so repeated transforms reuse one compiled
+        program."""
         codecs = list(self._codecs)
         if any(c is None for c in codecs):
             raise CodecError("codec plan not resolved (no batch encoded "
                              "and no cache meta adopted)")
         if all(c.name == "identity" for c in codecs):
             return fn
-        cache_key = tuple(c.key() for c in codecs)
+        cache_key = (tuple(c.key() for c in codecs), bool(donate))
         per_fn = getattr(fn, "_tpudl_codec_wrap", None)
         if per_fn is not None and cache_key in per_fn:
             return per_fn[cache_key]
         import jax
 
-        @jax.jit
         def wrapped(*xs):
             return fn(*[c.prologue(x) for c, x in zip(codecs, xs)])
+
+        if donate:
+            filter_unusable_donation_warning()
+            wrapped = jax.jit(
+                wrapped, donate_argnums=tuple(range(len(codecs))))
+        else:
+            wrapped = jax.jit(wrapped)
 
         try:
             if per_fn is None:

@@ -184,6 +184,86 @@ class _PipelineInfeed:
         self._ex.shutdown(wait=False, cancel_futures=True)
 
 
+class _DispatchWindow:
+    """D-deep in-flight dispatch window — the futures-not-syncs executor
+    core (ROADMAP item 2). The consumer SUBMITS dispatch calls onto a
+    small pool and only blocks once ``depth`` results are already in
+    flight, so the tunnel's blocking per-dispatch round-trip for batch N
+    rides under the dispatches of N+1..N+D instead of serializing the
+    loop. Results are consumed strictly in submission order (the output
+    row order is untouched, and bit-identity with depth 1 is structural:
+    the same per-batch programs run, only their round-trips overlap).
+
+    The consumer's blocked time lands in the ``dispatch_wait`` stage —
+    the UNHIDDEN dispatch residue, the analogue of ``infeed_wait`` on
+    the prepare side — while the pool threads' ``dispatch`` stage
+    seconds become pool-summed (like ``prepare``, they may exceed wall
+    time; tpudl.obs.roofline reads ``dispatch_wait`` when present so
+    overlapped time is not attributed twice). ``dispatch_inflight`` is
+    gauged at every submit; its max can never exceed ``depth``.
+
+    The first dispatch runs alone (the window stays at 1 until the
+    first result is consumed): one thread traces/compiles the program,
+    and the outfeed mode is picked before the window floods."""
+
+    def __init__(self, depth: int, report):
+        self._depth = max(1, int(depth))
+        self._ex = ThreadPoolExecutor(max_workers=self._depth,
+                                      thread_name_prefix="tpudl-dispatch")
+        self._futs: deque = deque()
+        self._report = report
+        self._primed = False
+
+    def __len__(self) -> int:
+        return len(self._futs)
+
+    def full(self) -> bool:
+        if not self._primed:
+            return bool(self._futs)  # warmup: one dispatch at a time
+        return len(self._futs) >= self._depth
+
+    def submit(self, call):
+        self._futs.append(self._ex.submit(call))
+        self._report.gauge("dispatch_inflight", len(self._futs))
+
+    def pop(self):
+        """Oldest in-flight dispatch's (result, n_pad), in submission
+        order. Blocks only when that dispatch is still in its round
+        trip — the wait IS the unhidden residue, accounted as its own
+        ``dispatch_wait`` stage (deliberately NOT ``dispatch``: the
+        pool already timed the call there)."""
+        fut = self._futs.popleft()
+        self._primed = True
+        with self._report.stage("dispatch_wait"):
+            try:
+                out = fut.result()
+            except BaseException:
+                self.close()
+                raise  # the dispatch thread's original exception
+        return out
+
+    def close(self):
+        """Release the pool on every exit path (mirrors
+        _PipelineInfeed.close): queued dispatches are cancelled and the
+        workers exit as soon as any in-flight call returns."""
+        for f in self._futs:
+            f.cancel()
+        self._futs.clear()
+        self._ex.shutdown(wait=False, cancel_futures=True)
+
+
+def _start_host_copies(result) -> None:
+    """Start the device→host copy of every output of one dispatch, ON
+    the thread that issued it — D2H of batch N then overlaps the
+    dispatch of N+1..N+D (and, at depth 1, the next batch's prepare),
+    for BOTH outfeed modes: the windowed drain's ``np.asarray`` and the
+    accumulated fetch both find their copies already in flight. Host
+    arrays (host fns) have no async copy and need none."""
+    for r in result:
+        if hasattr(r, "copy_to_host_async"):
+            r.copy_to_host_async()
+
+
 def _is_device_fn(fn) -> bool:
     """Jitted/device-fn detection: any ``jax.stages.Wrapped`` (jit,
     pjit, AOT wrappers) counts, plus the legacy ``lower`` probe for
@@ -219,7 +299,8 @@ def _warn_device_outputs_once():
         "executor.", RuntimeWarning, stacklevel=3)
 
 
-def _fused_wrapper(fn: Callable, m: int) -> Callable:
+def _fused_wrapper(fn: Callable, m: int, *, n_args: int | None = None,
+                   donate: bool = False) -> Callable:
     """ONE compiled program that runs ``m`` microbatches per dispatch:
     inputs are stacked (m, B, ...), a ``lax.scan`` applies ``fn`` to
     each microbatch on-device, outputs come back flattened (m·B, ...).
@@ -228,19 +309,28 @@ def _fused_wrapper(fn: Callable, m: int) -> Callable:
     entirely that per-step round-trip (GPipe-style multi-step fusion,
     Huang et al. 2019).
 
-    The wrapper is cached ON fn itself (``fn._tpudl_fused[m]``): the
+    ``donate=True`` marks every stacked input as donated
+    (``jax.jit(..., donate_argnums=...)``): XLA may reuse the staged
+    input buffers for outputs/temps, so steady-state fused dispatch
+    allocates nothing extra device-side. Safe by construction here —
+    the stacked arrays are freshly ``np.stack``-built host batches the
+    executor never reads again (donation changes no values; the
+    depth-1/donation-off bit-identity tests pin this).
+
+    The wrapper is cached ON fn itself (``fn._tpudl_fused[key]``): the
     fused program — whose closure pins fn and, transitively, its model
     weights — then lives exactly as long as fn does; the fn↔wrapper
     reference cycle is an ordinary gc-collectible cycle, so a discarded
     transformer frees both (a module-level cache keyed by fn would keep
     the pair alive forever: the wrapper's closure references its own
     key)."""
+    donate = bool(donate and n_args)
+    key = (int(m), donate)
     per_fn = getattr(fn, "_tpudl_fused", None)
-    if per_fn is not None and int(m) in per_fn:
-        return per_fn[int(m)]
+    if per_fn is not None and key in per_fn:
+        return per_fn[key]
     import jax
 
-    @jax.jit
     def fused(*stacked):
         def body(carry, xs):
             r = fn(*xs)
@@ -252,10 +342,18 @@ def _fused_wrapper(fn: Callable, m: int) -> Callable:
         return tuple(
             y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:]) for y in ys)
 
+    if donate:
+        from tpudl.data import codec as _codec
+
+        _codec.filter_unusable_donation_warning()
+        fused = jax.jit(fused, donate_argnums=tuple(range(int(n_args))))
+    else:
+        fused = jax.jit(fused)
+
     try:
         if per_fn is None:
             per_fn = fn._tpudl_fused = {}
-        per_fn[int(m)] = fused
+        per_fn[key] = fused
     except (AttributeError, TypeError):  # fn rejects attributes: uncached
         pass
     return fused
@@ -462,6 +560,9 @@ class Frame:
         prefetch_depth: int | None = None,
         prepare_workers: int | None = None,
         fuse_steps: int | None = None,
+        dispatch_depth: int | None = None,
+        donate: bool | None = None,
+        autotune: bool | None = None,
         device_fn: bool | None = None,
         wire_codec=None,
         cache_dir: str | None = None,
@@ -498,7 +599,31 @@ class Frame:
            a tunneled backend pays one dispatch round-trip per M batches
            (the per-step dispatch latency is ~93% of wall time on the
            judged config, PROFILE.md);
-        4. the windowed/accumulated async outfeed (unchanged).
+        4. a ``dispatch_depth``-deep ASYNC dispatch window
+           (``TPUDL_FRAME_DISPATCH_DEPTH``, default 2; device fns,
+           mesh=None) — up to D dispatches stay in flight as futures,
+           so the blocking per-dispatch round-trip of batch N rides
+           under the dispatches of N+1..N+D; the hot loop never calls
+           ``block_until_ready``/``np.asarray`` on a device result.
+           With ``donate`` (``TPUDL_FRAME_DONATE``, default on), fused
+           and codec-wrapped programs donate their input buffers
+           (``jax.jit(..., donate_argnums=...)``) so steady-state
+           dispatch allocates nothing extra device-side; shard-cache
+           hits are handed to donating programs as writable COPIES,
+           never the cache's read-only mmap;
+        5. the windowed/accumulated async outfeed — the device→host
+           copy of every output starts AT dispatch
+           (``copy_to_host_async``), in both outfeed modes, so D2H of
+           batch N overlaps later dispatches.
+
+        ``autotune`` (``TPUDL_FRAME_AUTOTUNE``, default on): any of
+        ``fuse_steps``/``dispatch_depth``/``prefetch_depth`` left unset
+        (no kwarg, no env) is SEEDED from the knob advisor's ranked
+        recommendations over the previous run's PipelineReport
+        (``obs.analyze_roofline()`` — wire probe + device ms/step +
+        report gauges; PIPELINE.md "Async dispatch"). The chosen values
+        land on the report's config (``autotuned`` names the seeded
+        knobs); explicit kwargs/env always win.
 
         ``prefetch`` defaults to on for device fns, off for host fns
         (whose inputs must stay numpy). ``device_fn`` overrides the
@@ -538,10 +663,70 @@ class Frame:
         killed = os.environ.get("TPUDL_FRAME_PREFETCH", "1") == "0"
         if killed:
             prefetch = False
-        depth = (int(prefetch_depth) if prefetch_depth is not None
-                 else _env_int("TPUDL_FRAME_PREFETCH_DEPTH", 2))
+        # -- autotune: seed unset executor knobs from the advisor ---------
+        # (ROADMAP 2's closed loop: fuse_steps / dispatch_depth /
+        # prefetch_depth come from obs.analyze_roofline()'s ranked recs
+        # over the PREVIOUS run's report + the wire probe + device
+        # ms/step, instead of hand-set env knobs. Explicit kwargs and
+        # env settings always win; the serial kill switch, host fns and
+        # the mesh path never autotune.)
+        autotune_on = (
+            (bool(autotune) if autotune is not None
+             else os.environ.get("TPUDL_FRAME_AUTOTUNE", "1") != "0")
+            and not killed and device_flag and mesh is None)
+        seeds: dict = {}
+        seeded: list[str] = []
+
+        def _resolve(kwarg, env_name, seed_key, default):
+            if kwarg is not None:
+                return int(kwarg)
+            if os.environ.get(env_name, "") != "":
+                return _env_int(env_name, default)
+            if seed_key in seeds:
+                seeded.append(seed_key)
+                return int(seeds[seed_key])
+            return default
+
+        if autotune_on and any(
+                k is None and os.environ.get(e, "") == ""
+                for k, e in ((fuse_steps, "TPUDL_FRAME_FUSE_STEPS"),
+                             (dispatch_depth, "TPUDL_FRAME_DISPATCH_DEPTH"),
+                             (prefetch_depth, "TPUDL_FRAME_PREFETCH_DEPTH"))):
+            # read the PREVIOUS run's report before this run files its
+            # own into the ring below; never probe the wire from here
+            # (the cached probe / TPUDL_WIRE_MBPS is consumed if known).
+            # batch_size is the workload guard: the advisor's numbers
+            # are per-dispatch quantities at that batch geometry, and a
+            # process alternating workloads must not cross-tune them
+            from tpudl.obs import roofline as _roofline
+
+            seeds = _roofline.autotune_seed(
+                allow_probe=False,
+                match={"batch_size": int(batch_size)})
+        depth = _resolve(prefetch_depth, "TPUDL_FRAME_PREFETCH_DEPTH",
+                         "prefetch_depth", 2)
         workers = (int(prepare_workers) if prepare_workers is not None
                    else _env_int("TPUDL_FRAME_PREPARE_WORKERS", 2))
+        d_depth = max(1, _resolve(dispatch_depth,
+                                  "TPUDL_FRAME_DISPATCH_DEPTH",
+                                  "dispatch_depth", 2))
+        if killed or mesh is not None or not device_flag:
+            # the async window needs a device fn returning futures and
+            # no mesh sharding in the dispatch path; the kill switch
+            # must yield the fully serial executor (bench baseline arm)
+            d_depth = 1
+        donate_flag = (bool(donate) if donate is not None
+                       else os.environ.get("TPUDL_FRAME_DONATE", "1")
+                       != "0")
+        if killed or mesh is not None or not device_flag:
+            donate_flag = False
+        if d_depth > 1 and prefetch and prefetch_depth is None and \
+                os.environ.get("TPUDL_FRAME_PREFETCH_DEPTH", "") == "" \
+                and "prefetch_depth" not in seeds:
+            # a D-deep dispatch window drains prepared batches D at a
+            # time: the DEFAULT infeed must be able to feed it (explicit
+            # kwarg/env/seeded depths are respected as set)
+            depth = max(depth, d_depth)
         if (prepare_workers is None
                 and "TPUDL_FRAME_PREPARE_WORKERS" not in os.environ
                 and pack is not None
@@ -552,8 +737,8 @@ class Frame:
             # kwarg/env, or by marking the callable ``pack.thread_safe
             # = True`` (the first-party packs are marked)
             workers = 1
-        fuse = max(1, (int(fuse_steps) if fuse_steps is not None
-                       else _env_int("TPUDL_FRAME_FUSE_STEPS", 1)))
+        fuse = max(1, _resolve(fuse_steps, "TPUDL_FRAME_FUSE_STEPS",
+                               "fuse_steps", 1))
         if killed or mesh is not None or not device_flag:
             # fusion stacks unsharded host batches into one jittable
             # program: it needs a device fn and no mesh sharding, and the
@@ -626,13 +811,18 @@ class Frame:
                     plan.adopt(cache.meta["codecs"])
 
         report.config = {
-            "executor": ("pipelined" if (prefetch or fuse > 1)
+            "executor": ("pipelined" if (prefetch or fuse > 1
+                                         or d_depth > 1)
                          else "serial"),
             "prefetch": bool(prefetch),
             "prefetch_depth": int(depth) if prefetch else 0,
             "prepare_workers": (max(1, min(workers, depth))
                                 if prefetch else 0),
             "fuse_steps": fuse,
+            "dispatch_depth": int(d_depth),
+            "donate": bool(donate_flag),
+            "autotune": bool(autotune_on),
+            "autotuned": sorted(seeded),
             "batch_size": int(batch_size),
             "rows": self._n,
             "wire_codec": (plan.names()[0] if plan is not None
@@ -674,8 +864,21 @@ class Frame:
                         # they keep the zero-copy read-only mmap; a
                         # host fn may mutate in place (legal on the
                         # cold path's fresh arrays), so warm batches
-                        # must be writable copies or cold/warm diverge
-                        packed = (list(hit) if device_flag
+                        # must be writable copies or cold/warm diverge.
+                        # DONATING programs also get writable copies: a
+                        # donated buffer hands XLA write access, and on
+                        # a backend that zero-copies host numpy that
+                        # would be the shard file itself (DATA.md). The
+                        # only donating program that can SEE these hit
+                        # buffers is the codec wrapper's per-batch path
+                        # (plan is not None); the fused path re-stacks
+                        # into fresh arrays, and without a plan no
+                        # wrapper exists to carry donate_argnums — the
+                        # default (donate on, no codec) keeps zero-copy
+                        # mmap replay
+                        donate_sees_hit = donate_flag and plan is not None
+                        packed = (list(hit)
+                                  if device_flag and not donate_sees_hit
                                   else [np.array(a) for a in hit])
                         cache_hit = True
                 if packed is None:
@@ -786,12 +989,11 @@ class Frame:
                 segs.append((int(result[0].shape[0]), n_pad))
             else:
                 # Large outputs (e.g. outputMode='image'): bounded
-                # window so device memory stays O(window · batch), with
-                # the host copy started at dispatch so it overlaps later
-                # batches' compute.
-                for r in result:
-                    if hasattr(r, "copy_to_host_async"):
-                        r.copy_to_host_async()
+                # window so device memory stays O(window · batch). The
+                # host copy already started AT dispatch
+                # (_start_host_copies on the dispatching thread), so the
+                # drain below blocks only on the oldest entry's
+                # in-flight copy.
                 pending.append((tuple(result), n_pad))
                 if len(pending) > _PIPELINE_WINDOW:
                     with report.stage("d2h"):
@@ -823,21 +1025,73 @@ class Frame:
             return out
 
         run_fn = fn if plan is None else None
+        run_fn_direct = fn if plan is None else None
 
         def _run_fn():
             """``fn`` with the codec prologues fused in front (ONE jit
             program, see CodecPlan.wrap) — bindable only after the
             first batch prepared ('auto' codecs pick from it), hence
-            the lazy bind; identity plans return ``fn`` itself."""
+            the lazy bind; identity plans return ``fn`` itself. This is
+            the NON-donating variant the fused wrapper traces inline
+            (donation belongs to the outermost jit only)."""
             nonlocal run_fn
             if run_fn is None:
                 run_fn = plan.wrap(fn)
             return run_fn
 
+        def _run_fn_direct():
+            """The per-batch dispatch program: donates its inputs when
+            donation is armed and the codec wrapper exists to carry the
+            ``donate_argnums`` (a bare user fn is never re-jitted just
+            to donate — donation rides the wrappers the executor
+            already owns)."""
+            nonlocal run_fn_direct
+            if run_fn_direct is None:
+                run_fn_direct = plan.wrap(fn, donate=donate_flag)
+            return run_fn_direct
+
+        window = (_DispatchWindow(d_depth, report) if d_depth > 1
+                  else None)
+
+        def dispatch(call_fn, args, idx, n_pad, fused=False):
+            """Issue one dispatch: directly on the consumer (serial /
+            depth 1) or onto the in-flight window. The dispatch stage
+            itself — fault point, fn call, and starting the outputs'
+            device→host copies — runs on whichever thread executes it;
+            results are handled strictly in issue order."""
+            def run():
+                with report.stage("dispatch"):
+                    _faults.fire("frame.dispatch", index=idx)
+                    result = call_fn(*args)
+                if not isinstance(result, (tuple, list)):
+                    result = (result,)
+                # D2H starts NOW, at dispatch, for both outfeed modes —
+                # batch idx's copy overlaps the next dispatches
+                _start_host_copies(result)
+                return result, n_pad
+
+            if fused:
+                report.count("fused_dispatches")
+            if window is None:
+                handle(*run())
+                return
+            while window.full():
+                handle(*window.pop())
+            window.submit(run)
+
         t_wall = time.perf_counter()
         try:
             try:
                 while consumed < len(spans):
+                    if fuse > 1 and window is not None and mode is None \
+                            and len(window):
+                        # resolve the outfeed mode BEFORE stacking the
+                        # next fused group: if the first result picks
+                        # window mode, handle() drops fuse to 1 and the
+                        # O(window · batch) device-memory bound must
+                        # not be multiplied by an already-stacked group
+                        handle(*window.pop())
+                        continue
                     if fuse > 1 and consumed + fuse <= n_full:
                         group = [next_prepared() for _ in range(fuse)]
                         try:
@@ -848,25 +1102,23 @@ class Frame:
                             # (variable-geometry pack): dispatch this
                             # group per-batch
                             for packed, n_pad in group:
-                                with report.stage("dispatch"):
-                                    _faults.fire("frame.dispatch",
-                                                 index=consumed)
-                                    result = _run_fn()(*packed)
-                                handle(result, n_pad)
+                                dispatch(_run_fn_direct(), packed,
+                                         consumed, n_pad)
                             continue
-                        fused_fn = _fused_wrapper(_run_fn(), fuse)
-                        with report.stage("dispatch"):
-                            _faults.fire("frame.dispatch", index=consumed)
-                            result = fused_fn(*stacked)
-                        report.count("fused_dispatches")
-                        handle(result, 0)
+                        fused_fn = _fused_wrapper(
+                            _run_fn(), fuse, n_args=len(input_cols),
+                            donate=donate_flag)
+                        dispatch(fused_fn, stacked, consumed, 0,
+                                 fused=True)
                     else:
                         packed, n_pad = next_prepared()
-                        with report.stage("dispatch"):
-                            _faults.fire("frame.dispatch", index=consumed)
-                            result = _run_fn()(*packed)
-                        handle(result, n_pad)
+                        dispatch(_run_fn_direct(), packed, consumed,
+                                 n_pad)
+                while window is not None and len(window):
+                    handle(*window.pop())
             finally:
+                if window is not None:
+                    window.close()
                 if infeed is not None:
                     infeed.close()
                 if cache is not None:
@@ -919,23 +1171,32 @@ def _pick_fetch_mode(result, est_total_rows: int) -> str:
 
 
 def _fetch_accumulated(acc, segs, outputs):  # tpudl: hot-path
-    """Concatenate per-column device results and fetch each ONCE; strip
-    per-batch mesh padding host-side."""
-    import jax.numpy as jnp
-
+    """Fetch the accumulated device results: start (or re-arm)
+    ``copy_to_host_async`` on EVERY pending array first, so all the
+    copies cross the tunnel concurrently, THEN convert each chunk —
+    each ``np.asarray`` blocks only on its own already-in-flight copy
+    instead of issuing one serialized round-trip at a time (the
+    round-10 d2h fix; dispatch normally armed these copies already —
+    re-arming a finished copy is a no-op). Concatenation happens
+    host-side; per-batch mesh padding is stripped per segment."""
+    for chunks in acc:
+        for r in chunks:
+            if hasattr(r, "copy_to_host_async"):
+                r.copy_to_host_async()
     for i, chunks in enumerate(acc):
         if not chunks:
             continue
-        cat = jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
-        # tpudl: ignore[hot-sync] — this fetch IS the d2h stage: one
-        # round-trip per COLUMN at run end (the whole point of acc mode)
-        host = np.asarray(cat)
+        # tpudl: ignore[hot-sync] — this fetch IS the d2h stage: every
+        # chunk's copy is already in flight (armed above + at dispatch),
+        # so each conversion awaits its own copy, nothing else
+        parts = [np.asarray(r) for r in chunks]
+        host = np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
         if any(n_pad for _, n_pad in segs):
-            parts, pos = [], 0
+            out, pos = [], 0
             for padded_len, n_pad in segs:
-                parts.append(host[pos: pos + padded_len - n_pad])
+                out.append(host[pos: pos + padded_len - n_pad])
                 pos += padded_len
-            outputs[i].extend(parts)
+            outputs[i].extend(out)
         else:
             outputs[i].append(host)
 

@@ -78,7 +78,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                  imageLoader=None, modelFile=None, kerasOptimizer=None,
                  kerasLoss=None, kerasFitParams=None, mesh=None,
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
-                 wireCodec=None, cacheDir=None, trialRetryPolicy=None):
+                 dispatchDepth=None, wireCodec=None, cacheDir=None,
+                 trialRetryPolicy=None):
         super().__init__()
         self._setDefault(kerasFitParams={"batch_size": 32, "epochs": 1,
                                          "verbose": 0})
@@ -88,6 +89,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         self.prefetchDepth = prefetchDepth
         self.prepareWorkers = prepareWorkers
         self.fuseSteps = fuseSteps
+        self.dispatchDepth = dispatchDepth
         # tpudl.data knobs (DATA.md): cacheDir shards the bulk image
         # load (a re-fit over the same files performs ZERO decodes);
         # wireCodec rides into the returned transformer. A loader
@@ -112,7 +114,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         kwargs = dict(self._input_kwargs)
         kwargs.pop("mesh", None)
         for k in ("prefetchDepth", "prepareWorkers", "fuseSteps",
-                  "wireCodec", "cacheDir", "trialRetryPolicy"):
+                  "dispatchDepth", "wireCodec", "cacheDir",
+                  "trialRetryPolicy"):
             kwargs.pop(k, None)
         self._set(**kwargs)
 
@@ -328,6 +331,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             modelFile=model_path, imageLoader=self.getImageLoader(),
             mesh=self.mesh, prefetchDepth=self.prefetchDepth,
             prepareWorkers=self.prepareWorkers, fuseSteps=self.fuseSteps,
+            dispatchDepth=self.dispatchDepth,
             wireCodec=self.wireCodec, cacheDir=self.cacheDir)
 
     # -- fit entry points --------------------------------------------------

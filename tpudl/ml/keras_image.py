@@ -30,7 +30,8 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
     def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
                  imageLoader=None, outputMode="vector", batchSize=64,
                  mesh=None, prefetchDepth=None, prepareWorkers=None,
-                 fuseSteps=None, wireCodec=None, cacheDir=None):
+                 fuseSteps=None, dispatchDepth=None, wireCodec=None,
+                 cacheDir=None):
         super().__init__()
         self._setDefault(outputMode="vector")
         self.batchSize = int(batchSize)

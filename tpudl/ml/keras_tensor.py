@@ -22,7 +22,8 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
     @keyword_only
     def __init__(self, *, inputCol=None, outputCol=None, modelFile=None,
                  batchSize=256, mesh=None, prefetchDepth=None,
-                 prepareWorkers=None, fuseSteps=None):
+                 prepareWorkers=None, fuseSteps=None,
+                 dispatchDepth=None):
         super().__init__()
         self.batchSize = int(batchSize)
         self.mesh = mesh
@@ -47,5 +48,6 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
             outputMapping={gin.output_names[0]: self.getOutputCol()},
             batchSize=self.batchSize, mesh=self.mesh,
             prefetchDepth=self.prefetchDepth,
-            prepareWorkers=self.prepareWorkers, fuseSteps=self.fuseSteps)
+            prepareWorkers=self.prepareWorkers, fuseSteps=self.fuseSteps,
+            dispatchDepth=self.dispatchDepth)
         return delegate.transform(frame)

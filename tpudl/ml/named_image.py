@@ -181,8 +181,8 @@ class DeepImageFeaturizer(_NamedImageTransformer):
     def __init__(self, *, inputCol=None, outputCol=None, modelName=None,
                  weights="random", batchSize=64, mesh=None,
                  computeDtype="float32", prefetchDepth=None,
-                 prepareWorkers=None, fuseSteps=None, wireCodec=None,
-                 cacheDir=None):
+                 prepareWorkers=None, fuseSteps=None, dispatchDepth=None,
+                 wireCodec=None, cacheDir=None):
         super().__init__()
         self.weights = weights
         self.batchSize = int(batchSize)
@@ -217,7 +217,7 @@ class DeepImagePredictor(_NamedImageTransformer):
                  decodePredictions=False, topK=5, weights="random",
                  batchSize=64, mesh=None, computeDtype="float32",
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
-                 wireCodec=None, cacheDir=None):
+                 dispatchDepth=None, wireCodec=None, cacheDir=None):
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5)
         self.weights = weights

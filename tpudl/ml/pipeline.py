@@ -71,16 +71,18 @@ class Transformer(Params):
     def _pipeline_opts(self) -> dict:
         """The ``Frame.map_batches`` pipelined-executor knobs every
         batch transformer plumbs through: prefetch depth (K), prepare
-        workers (N), fused dispatch steps (M), plus the tpudl.data
-        knobs — wire codec and prepared-batch cache dir (DATA.md).
-        None = resolve from the ``TPUDL_FRAME_*`` /
+        workers (N), fused dispatch steps (M), the async dispatch
+        window depth (D — PIPELINE.md "Async dispatch"), plus the
+        tpudl.data knobs — wire codec and prepared-batch cache dir
+        (DATA.md). None = resolve from the ``TPUDL_FRAME_*`` /
         ``TPUDL_WIRE_CODEC`` / ``TPUDL_DATA_CACHE_DIR`` env knobs /
-        defaults inside map_batches, so a transformer that never sets
-        them still rides the pipeline."""
+        autotune / defaults inside map_batches, so a transformer that
+        never sets them still rides the pipeline."""
         return {
             "prefetch_depth": getattr(self, "prefetchDepth", None),
             "prepare_workers": getattr(self, "prepareWorkers", None),
             "fuse_steps": getattr(self, "fuseSteps", None),
+            "dispatch_depth": getattr(self, "dispatchDepth", None),
             "wire_codec": getattr(self, "wireCodec", None),
             "cache_dir": getattr(self, "cacheDir", None),
         }
@@ -93,6 +95,7 @@ class Transformer(Params):
         self.prefetchDepth = kwargs.pop("prefetchDepth", None)
         self.prepareWorkers = kwargs.pop("prepareWorkers", None)
         self.fuseSteps = kwargs.pop("fuseSteps", None)
+        self.dispatchDepth = kwargs.pop("dispatchDepth", None)
         self.wireCodec = kwargs.pop("wireCodec", None)
         self.cacheDir = kwargs.pop("cacheDir", None)
 

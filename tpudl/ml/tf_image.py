@@ -76,7 +76,12 @@ class ImageBatchWarmup:
                 fuse = _frame._env_int("TPUDL_FRAME_FUSE_STEPS", 1)
             if (int(fuse) > 1
                     and _os.environ.get("TPUDL_FRAME_PREFETCH", "1") != "0"):
-                fused = _frame._fused_wrapper(jfn, int(fuse))
+                # match the executor's donation setting, or this warms
+                # a program variant the timed window never runs
+                donate = (_os.environ.get("TPUDL_FRAME_DONATE", "1")
+                          != "0")
+                fused = _frame._fused_wrapper(jfn, int(fuse), n_args=1,
+                                              donate=donate)
                 xs = np.zeros((int(fuse),) + x.shape, dtype=dtype)
                 jax.block_until_ready(fused(xs))
         return self
@@ -112,7 +117,7 @@ class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
                  inputTensor=None, outputTensor=None, channelOrder="RGB",
                  outputMode="vector", batchSize=64, mesh=None,
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
-                 wireCodec=None, cacheDir=None):
+                 dispatchDepth=None, wireCodec=None, cacheDir=None):
         super().__init__()
         self._setDefault(channelOrder="RGB", outputMode="vector")
         self.batchSize = int(batchSize)

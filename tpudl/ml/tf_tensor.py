@@ -28,7 +28,8 @@ class TFTransformer(Transformer):
     @keyword_only
     def __init__(self, *, tfInputGraph=None, inputMapping=None,
                  outputMapping=None, batchSize=256, mesh=None,
-                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None):
+                 prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
+                 dispatchDepth=None):
         super().__init__()
         self.batchSize = int(batchSize)
         self.mesh = mesh

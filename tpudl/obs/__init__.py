@@ -49,7 +49,7 @@ from __future__ import annotations
 from tpudl.obs.flight import dump, get_recorder, record_error
 from tpudl.obs.live import (ensure_status_writer, start_status_writer,
                             stop_status_writer, write_status)
-from tpudl.obs.roofline import RooflineReport, advise
+from tpudl.obs.roofline import RooflineReport, advise, autotune_seed
 from tpudl.obs.roofline import analyze as analyze_roofline
 from tpudl.obs.metrics import (Meter, counter, flush_metrics, gauge,
                                get_registry, histogram, snapshot, timed)

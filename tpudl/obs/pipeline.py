@@ -49,10 +49,20 @@ class PipelineReport:
     - ``h2d``: the explicit shard + host→device transfer inside prepare
       (mesh path only; on the mesh=None tunnel path the transfer rides
       the dispatch, see map_batches);
-    - ``dispatch``: consumer-thread seconds in ``fn(...)`` — enqueue
-      only for async device fns, enqueue+compute for host fns;
+    - ``dispatch``: seconds in ``fn(...)`` — on the serial path these
+      are consumer-thread seconds (enqueue only for async device fns,
+      enqueue+compute for host fns); under the D-deep async dispatch
+      window they are POOL-SUMMED across the dispatch threads and may
+      exceed wall time (like ``prepare``) — the consumer-visible cost
+      is ``dispatch_wait``;
+    - ``dispatch_wait``: consumer seconds blocked on the in-flight
+      dispatch window (async executor only) — the UNHIDDEN dispatch
+      residue, the round-trip time depth D failed to hide (the
+      ``infeed_wait`` analogue of the dispatch side; the roofline model
+      reads this, not the pool-summed ``dispatch``, when present);
     - ``d2h``: device→host fetch time (windowed drain + the acc-mode
-      final fetch);
+      final fetch — the copies themselves start at dispatch, so this
+      measures only the unoverlapped tail);
     - ``infeed_wait``: consumer seconds blocked on the infeed queue —
       the UNHIDDEN remainder of prepare, and the numerator of
       ``overlap_efficiency``.
@@ -134,6 +144,21 @@ class PipelineReport:
                     cap=GAUGE_SAMPLE_CAP)
         h.observe(value)
 
+    def dispatch_overlap_s(self) -> float | None:
+        """Dispatch seconds HIDDEN from the consumer by the in-flight
+        window: pool-summed ``dispatch`` minus the consumer's
+        ``dispatch_wait``. On the async executor this is the round-trip
+        time that rode under other dispatches — the ROADMAP-2 win as
+        one number (published as the ``frame.dispatch.overlap_s``
+        gauge). None for serial runs (no window, nothing overlapped);
+        clamped at 0 so measurement jitter never reports negative
+        overlap."""
+        with self._lock:
+            if "dispatch_wait" not in self.stages:
+                return None
+            return max(0.0, self.stages.get("dispatch", 0.0)
+                       - self.stages.get("dispatch_wait", 0.0))
+
     def overlap_efficiency(self) -> float | None:
         """Fraction of host prepare work hidden under device compute:
         1 - infeed_wait/prepare, clamped to [0, 1]. 1.0 = the consumer
@@ -170,6 +195,16 @@ class PipelineReport:
         eff = self.overlap_efficiency()
         if eff is not None:
             _metrics.gauge("frame.overlap_efficiency").set(eff)
+        # the async dispatch window's run-level truth (ROADMAP 2):
+        # mean in-flight depth + the seconds the window actually hid
+        overlap = self.dispatch_overlap_s()
+        if overlap is not None:
+            _metrics.gauge("frame.dispatch.overlap_s").set(overlap)
+        with self._lock:
+            inflight = self.gauges.get("dispatch_inflight")
+        if inflight is not None:
+            _metrics.gauge("frame.dispatch.inflight").set(
+                inflight.to_dict()["mean"])
         _metrics.get_registry().maybe_flush()
 
     def report(self) -> dict:
@@ -195,6 +230,9 @@ class PipelineReport:
         eff = self.overlap_efficiency()
         if eff is not None:
             out["overlap_efficiency"] = round(eff, 3)
+        overlap = self.dispatch_overlap_s()
+        if overlap is not None:
+            out["dispatch_overlap_s"] = round(overlap, 4)
         return out
 
 

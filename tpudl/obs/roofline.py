@@ -37,11 +37,18 @@ import os
 
 from tpudl.obs.metrics import _env_float
 
-__all__ = ["RooflineReport", "analyze", "advise", "KNOB_CAPS"]
+__all__ = ["RooflineReport", "analyze", "advise", "autotune_seed",
+           "KNOB_CAPS", "AUTOTUNE_KNOBS"]
 
 # advisor ceilings — the executor's own sane bounds (a recommendation
-# past these would trade host RAM / compile time for nothing)
-KNOB_CAPS = {"fuse_steps": 16, "prefetch_depth": 8, "prepare_workers": 8}
+# past these would trade host RAM / compile time / in-flight device
+# buffers for nothing)
+KNOB_CAPS = {"fuse_steps": 16, "prefetch_depth": 8, "prepare_workers": 8,
+             "dispatch_depth": 8}
+
+# the knobs Frame.map_batches seeds from advise() when left unset
+# (TPUDL_FRAME_AUTOTUNE, on by default — the ROADMAP-2 closed loop)
+AUTOTUNE_KNOBS = ("fuse_steps", "dispatch_depth", "prefetch_depth")
 
 # a component under this share of the gap is not worth a knob verdict
 _MINOR_FRAC = 0.10
@@ -176,6 +183,13 @@ def analyze(report: dict | None = None, *,
     rows = report.get("rows_done") or report.get("rows") or 0
     wall = report.get("wall_seconds") or report.get("age_s") or 0.0
     dispatch_s = float(stages.get("dispatch", 0.0))
+    if "dispatch_wait" in stages:
+        # async dispatch window: the ``dispatch`` stage is pool-summed
+        # across the window's threads (it may exceed wall time) and the
+        # overlapped part is already HIDDEN — attributing it would
+        # mis-charge time the executor paid for once. What the consumer
+        # actually paid is the window wait: the unhidden residue.
+        dispatch_s = float(stages.get("dispatch_wait", 0.0))
     if n_disp <= 0 or wall <= 0 or rows <= 0:
         return None
 
@@ -261,6 +275,7 @@ def analyze(report: dict | None = None, *,
             "bytes_prepared": bytes_prepared,
             "n_dispatches": n_disp,
             "fuse_steps": report.get("fuse_steps"),
+            "dispatch_depth": report.get("dispatch_depth"),
             "prefetch_depth": report.get("prefetch_depth"),
             "prepare_workers": report.get("prepare_workers"),
             "wire_codec": report.get("wire_codec"),
@@ -283,8 +298,15 @@ def advise(rr: RooflineReport) -> list[dict]:
     reason}``. The predictions come from the SAME decomposition the
     attribution used — no second model:
 
-    - **dispatch round-trip** amortizes 1/fuse: raising ``fuse_steps``
-      f→f' keeps f/f' of the overhead;
+    - **dispatch round-trip**, first choice: the D-deep async dispatch
+      window overlaps the round-trips themselves — depth d→d' keeps
+      d/d' of the overhead visible AND hides the same share of the d2h
+      drain (copies start at dispatch), with no recompilation and no
+      full-size-batch constraint, which is why it outranks fusion on a
+      purely dispatch-bound run;
+    - **dispatch round-trip**, second lever: fusion amortizes 1/fuse —
+      raising ``fuse_steps`` f→f' keeps f/f' of the overhead (one
+      compiled program per f' microbatches; the two compose);
     - **unhidden prepare** halves (conservatively) when the pool
       doubles — prepare is embarrassingly parallel across batches, but
       decode sources may serialize internally;
@@ -310,7 +332,29 @@ def advise(rr: RooflineReport) -> list[dict]:
             "reason": reason,
         })
 
-    # 1) dispatch round-trip → fuse_steps
+    # 1) dispatch round-trip → dispatch_depth (the async window): depth
+    #    d hides all but ~1/d of the blocking round-trip residue, and —
+    #    because the D2H copies start at dispatch — the same share of
+    #    the outfeed drain rides under later dispatches. Recommended
+    #    FIRST: it needs no recompile and no full-size-batch run, so on
+    #    a purely dispatch-bound shape it is the cheaper, bigger win.
+    if (rr.dispatch_overhead_s is not None
+            and rr.dispatch_overhead_s > _MINOR_FRAC * rr.gap_s):
+        cur_dd = max(1, int(inp.get("dispatch_depth") or 1))
+        target_overhead = max(0.1 * (rr.device_compute_s or 0.0), 1e-3)
+        want_dd = cur_dd * rr.dispatch_overhead_s / target_overhead
+        new_dd = min(KNOB_CAPS["dispatch_depth"],
+                     max(2 * cur_dd, _next_pow2(want_dd)))
+        if new_dd > cur_dd:
+            hidden = 1.0 - cur_dd / new_dd
+            saved = (rr.dispatch_overhead_s + (rr.d2h_s or 0.0)) * hidden
+            _rec("dispatch_depth", cur_dd, new_dd, saved,
+                 f"dispatch round-trip is "
+                 f"{rr.dispatch_overhead_s:.2f}s of the run; a "
+                 f"{new_dd}-deep in-flight window overlaps the "
+                 f"round-trips (and the d2h drain) leaving "
+                 f"~{cur_dd}/{new_dd} visible, with no recompile")
+    # 2) dispatch round-trip → fuse_steps (composes with the window)
     if (rr.dispatch_overhead_s is not None
             and rr.dispatch_overhead_s > _MINOR_FRAC * rr.gap_s):
         cur = max(1, int(inp.get("fuse_steps") or 1))
@@ -327,7 +371,7 @@ def advise(rr: RooflineReport) -> list[dict]:
                  f"{rr.dispatch_overhead_s:.2f}s of the run; one fused "
                  f"program per {new} microbatches keeps ~{cur}/{new} "
                  f"of it")
-    # 2) unhidden prepare → prepare_workers (+ depth to feed them)
+    # 3) unhidden prepare → prepare_workers (+ depth to feed them)
     if (rr.prepare_unhidden_s is not None
             and rr.prepare_unhidden_s > _MINOR_FRAC * rr.gap_s):
         cur_w = max(1, int(inp.get("prepare_workers") or 1))
@@ -349,7 +393,7 @@ def advise(rr: RooflineReport) -> list[dict]:
                     "reason": "companion to prepare_workers — the queue "
                               "must hold the extra in-flight batches",
                 })
-    # 3) wire → codec
+    # 4) wire → codec
     codec = str(inp.get("wire_codec") or "off")
     if (rr.wire_h2d_s is not None
             and rr.wire_h2d_s > _MINOR_FRAC * rr.gap_s
@@ -400,3 +444,48 @@ def _publish(rr: RooflineReport) -> None:
     if rr.advice:
         _m.gauge("obs.roofline.predicted_gain_pct").set(
             rr.advice[0]["predicted_gain_pct"])
+
+
+def autotune_seed(report: dict | None = None, *,
+                  allow_probe: bool = False,
+                  match: dict | None = None) -> dict:
+    """The async executor's knob seed: ``{knob: value}`` for the
+    :data:`AUTOTUNE_KNOBS` the advisor recommends over the PREVIOUS
+    run's report (default: ``obs.last_pipeline_report()``) — how
+    ``TPUDL_FRAME_AUTOTUNE`` closes the ROADMAP-2 loop without
+    hand-set env knobs. Values are the advisor's own ``recommended``
+    numbers, clamped into :data:`KNOB_CAPS`; an empty dict (no prior
+    report, nothing attributable, no confident advice, or a
+    ``match`` miss) leaves the executor on its defaults.
+
+    ``match`` is the workload guard: ``{report_key: value}`` pairs the
+    prior report must carry verbatim, or nothing seeds. The executor
+    passes its own ``batch_size`` — the advisor's numbers are
+    per-dispatch quantities at THAT batch geometry, and a process that
+    alternates workloads (a big featurizer, then a tiny scorer) must
+    not tune each run for the other's report.
+
+    ``allow_probe`` defaults to False here — seeding happens on the
+    executor's hot setup path and must never issue a device op (the
+    cached probe / ``TPUDL_WIRE_MBPS`` is consumed when known)."""
+    if report is None:
+        from tpudl.obs import pipeline as _pipeline
+
+        report = _pipeline.last_pipeline_report()
+    if not report:
+        return {}
+    for key, want in (match or {}).items():
+        if report.get(key) != want:
+            return {}
+    rr = analyze(report, publish=False, allow_probe=allow_probe)
+    if rr is None:
+        return {}
+    seeds: dict = {}
+    for rec in rr.advice:
+        knob = rec.get("knob")
+        val = rec.get("recommended")
+        if knob in AUTOTUNE_KNOBS and knob not in seeds \
+                and isinstance(val, (int, float)):
+            cap = KNOB_CAPS.get(knob)
+            seeds[knob] = max(1, min(int(val), cap) if cap else int(val))
+    return seeds

@@ -20,7 +20,12 @@ watch it recover. This module is that demand side:
   - ``sigterm`` — SIGTERM-to-self (the preemption kill, delivered at
     an exact step instead of a racy external timer);
   - ``corrupt`` — flip one byte of the file named by the firing's
-    ``path`` ctx (shard/checkpoint bit-rot on the read path).
+    ``path`` ctx (shard/checkpoint bit-rot on the read path);
+  - ``delay`` — sleep ``seconds`` on the firing thread: the
+    deterministic stand-in for a high-latency dispatch round-trip
+    (the async-executor overlap acceptance tests inject a per-dispatch
+    tunnel this way and measure how much of it the D-deep window
+    hides).
 
 Plans arm process-locally (``with plan.armed(): ...``) or across a
 process boundary via ``TPUDL_FAULT_PLAN`` (JSON; the kill-mid-epoch
@@ -36,6 +41,7 @@ import builtins
 import json
 import os
 import signal
+import time
 
 from tpudl.testing import tsan as _tsan
 
@@ -71,8 +77,10 @@ class _Rule:
     def __init__(self, spec: dict):
         self.point = str(spec["point"])
         self.action = str(spec.get("action", "raise"))
-        if self.action not in ("raise", "sigterm", "corrupt", "unlink"):
+        if self.action not in ("raise", "sigterm", "corrupt", "unlink",
+                               "delay"):
             raise ValueError(f"unknown fault action {self.action!r}")
+        self.seconds = float(spec.get("seconds", 0.0))
         # triggers — all optional, all must match when present:
         self.at_call = spec.get("at_call")        # exactly the Nth call
         self.first_calls = spec.get("first_calls")  # calls 1..K
@@ -100,6 +108,8 @@ class _Rule:
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
+        if self.seconds:
+            d["seconds"] = self.seconds
         if self.when:
             d["when"] = self.when
         return d
@@ -140,6 +150,20 @@ class FaultPlan:
                      "first_calls": int(first_calls), "exc": exc,
                      "message": f"injected transient IO error "
                                 f"(first {first_calls} calls)"}])
+
+    @classmethod
+    def delay(cls, point: str, seconds: float,
+              first_calls: int | None = None) -> "FaultPlan":
+        """Sleep ``seconds`` at every firing of ``point`` (or only its
+        first K) — the deterministic per-dispatch tunnel latency the
+        overlap acceptance tests inject (``frame.dispatch``): a D-deep
+        window must hide all but ~1/D of it, a blocking executor pays
+        it per batch."""
+        rule: dict = {"point": point, "action": "delay",
+                      "seconds": float(seconds)}
+        if first_calls is not None:
+            rule["first_calls"] = int(first_calls)
+        return cls([rule])
 
     @classmethod
     def corrupt_on_read(cls, point: str = "shards.read",
@@ -192,6 +216,13 @@ class FaultPlan:
         # breadcrumb; the injected fault below must still fire
         except Exception:
             pass
+        if matched.action == "delay":
+            # on the FIRING thread deliberately: a delayed dispatch
+            # stage blocks its dispatch-window thread exactly like a
+            # slow tunnel round-trip would, so overlap tests measure
+            # the executor, not the harness
+            time.sleep(matched.seconds)
+            return
         if matched.action == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
             return  # the handler decides what dies; the firing returns

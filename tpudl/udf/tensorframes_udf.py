@@ -35,6 +35,7 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
                  prefetch_depth: int | None = None,
                  prepare_workers: int | None = None,
                  fuse_steps: int | None = None,
+                 dispatch_depth: int | None = None,
                  wire_codec=None,
                  cache_dir: str | None = None) -> UDF:
     """Register ``graph`` as a SQL UDF named ``udf_name``.
@@ -47,9 +48,10 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
     ``feeds_to_fields_map`` maps graph input name → frame column name
     (default: the input's own op name). ``register=False`` builds and
     returns the UDF without filing it in the registry.
-    ``prefetch_depth`` / ``prepare_workers`` / ``fuse_steps`` plumb the
-    ``Frame.map_batches`` pipelined-executor knobs (None = the
-    ``TPUDL_FRAME_*`` env defaults), so SQL-registered models ride the
+    ``prefetch_depth`` / ``prepare_workers`` / ``fuse_steps`` /
+    ``dispatch_depth`` plumb the ``Frame.map_batches``
+    pipelined-executor knobs (None = the ``TPUDL_FRAME_*`` env /
+    autotune defaults), so SQL-registered models ride the
     same staged pipeline as the ml transformers; ``wire_codec`` /
     ``cache_dir`` plumb the tpudl.data knobs the same way (DATA.md —
     wire-encoded uploads and the sharded prepared-batch cache), so a
@@ -124,6 +126,7 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
                 jfn, in_cols, [out_col], batch_size=batch_size, mesh=mesh,
                 prefetch_depth=prefetch_depth,
                 prepare_workers=prepare_workers, fuse_steps=fuse_steps,
+                dispatch_depth=dispatch_depth,
                 wire_codec=wire_codec, cache_dir=cache_dir)
         _obs_metrics.counter(f"udf.{udf_name}.calls").inc()
         _obs_metrics.counter(f"udf.{udf_name}.rows").inc(len(frame))
