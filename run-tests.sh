@@ -19,6 +19,14 @@ echo "== tsan pass (lock sanitizer armed over the concurrency subset) =="
 TPUDL_TSAN=1 TPUDL_FLIGHT_DIR="$(mktemp -d)" \
     python -m pytest tests/test_concurrency.py -q "$@" -m concurrency
 
+echo "== virtual-mesh executor subset (ISSUE 11 acceptance) =="
+# Target the mesh-executor module DIRECTLY (same rationale as the
+# armed concurrency subset above): a jax-version collection error in
+# an unrelated module exits pytest 1 even with
+# --continue-on-collection-errors, and set -e would otherwise let that
+# mask a mesh regression inside the full-suite noise.
+python -m pytest tests/test_mesh_executor.py -q "$@"
+
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 

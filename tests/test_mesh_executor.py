@@ -1,0 +1,436 @@
+"""Mesh-native fast path (ISSUE 11 tentpole) — tier-1, NOT slow.
+
+ROADMAP item 1's own acceptance bar, all on the simulated 8-device CPU
+mesh (the same public API as single-chip — no parallel-only code path):
+
+1. PARITY — fused (fuse_steps=4) + async (dispatch_depth=4) + donating
+   + u8-codec ``map_batches`` on the mesh is bitwise-identical (after
+   unpad) to the single-chip serial executor, across the whole
+   depth × donate × fuse matrix;
+2. HLO PIN — the data-sharded featurize program compiles with NO
+   all-gather (collectives limited to what the model itself requires:
+   a per-row featurize requires none);
+3. SURFACE — the PipelineReport carries the mesh shape, the
+   ``mesh_pad_rows`` gauge and the ``h2d`` stage; ``frame.mesh.*``
+   process gauges move; autotune's workload guard keys on topology;
+4. TOPOLOGY GUARD — a job resume on a different mesh is refused with a
+   clear error instead of silently resharding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpudl import mesh as M
+from tpudl import obs
+from tpudl.frame import Frame
+
+
+def _clean_env(monkeypatch):
+    for var in ("TPUDL_FRAME_PREFETCH", "TPUDL_FRAME_PREFETCH_DEPTH",
+                "TPUDL_FRAME_PREPARE_WORKERS", "TPUDL_FRAME_FUSE_STEPS",
+                "TPUDL_FRAME_DISPATCH_DEPTH", "TPUDL_FRAME_DONATE",
+                "TPUDL_FRAME_AUTOTUNE", "TPUDL_MESH_FAST_PATH",
+                "TPUDL_WIRE_CODEC", "TPUDL_DATA_CACHE_DIR",
+                "TPUDL_WIRE_MBPS", "TPUDL_DEVICE_MS_PER_STEP"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def _frame(n=40, cols=6, seed=7):
+    rng = np.random.default_rng(seed)
+    return Frame({"x": rng.integers(
+        0, 256, size=(n, cols)).astype(np.float32)})
+
+
+def _ref(f, jfn, batch_size=8):
+    """Single-chip fully-serial reference (the pre-PR-2 executor)."""
+    out = f.map_batches(jfn, ["x"], ["y"], batch_size=batch_size,
+                        prefetch=False, dispatch_depth=1, donate=False,
+                        autotune=False)
+    return np.asarray(list(out["y"]), np.float32)
+
+
+class TestMeshFastPathParity:
+    def test_depth_donate_fuse_matrix_bitwise_vs_single(self, mesh8,
+                                                        monkeypatch):
+        """THE acceptance matrix: every depth × donate × fuse cell of
+        the mesh executor is byte-equal to the single-chip serial
+        run (after unpad) — sharding buys parallelism, never drift."""
+        _clean_env(monkeypatch)
+        f = _frame()
+        jfn = jax.jit(lambda b: (b * 3.0 + 0.5).sum(axis=1))
+        ref_y = _ref(f, jfn)
+        for depth in (1, 4):
+            for donate in (False, True):
+                for fuse in (1, 4):
+                    out = f.map_batches(
+                        jfn, ["x"], ["y"], batch_size=8, mesh=mesh8,
+                        dispatch_depth=depth, donate=donate,
+                        fuse_steps=fuse, autotune=False)
+                    np.testing.assert_array_equal(
+                        np.asarray(list(out["y"]), np.float32), ref_y,
+                        err_msg=f"mesh depth={depth} donate={donate} "
+                                f"fuse={fuse}")
+                    rep = obs.last_pipeline_report()
+                    assert rep["mesh"] == {"data": 8, "model": 1}
+                    assert rep["dispatch_depth"] == depth
+                    assert rep["fuse_steps"] == fuse
+                    assert rep["donate"] is donate
+
+    def test_u8_codec_fused_async_donating_mesh_bitwise(self, mesh8,
+                                                        monkeypatch):
+        """The full fast path at once — u8 wire codec restored by the
+        fused prologue, 4-step fusion, 4-deep window, donation — under
+        NamedSharding, bitwise vs the serial single-chip run."""
+        _clean_env(monkeypatch)
+        f = _frame()
+        jfn = jax.jit(lambda b: (b * 3.0 + 0.5).sum(axis=1))
+        ref_y = _ref(f, jfn)
+        out = f.map_batches(jfn, ["x"], ["y"], batch_size=8, mesh=mesh8,
+                            wire_codec="u8", fuse_steps=4,
+                            dispatch_depth=4, donate=True,
+                            autotune=False)
+        np.testing.assert_array_equal(
+            np.asarray(list(out["y"]), np.float32), ref_y)
+        rep = obs.last_pipeline_report()
+        assert rep["wire_codec"] == "u8"
+        # 40 rows / batch 8 = 5 full batches -> one fused group of 4
+        assert rep["stage_calls"].get("fused_dispatches") == 1
+
+    def test_ragged_tail_pads_and_unpads(self, mesh8, monkeypatch):
+        """21 rows at batch 8: full batches shard clean, the 5-row tail
+        pads to 8 and unpads bit-exactly; pad accounting moves."""
+        _clean_env(monkeypatch)
+        f = _frame(n=21)
+        jfn = jax.jit(lambda b: b.sum(axis=1))
+        ref_y = _ref(f, jfn)
+        out = f.map_batches(jfn, ["x"], ["y"], batch_size=8, mesh=mesh8,
+                            fuse_steps=2, dispatch_depth=4,
+                            autotune=False)
+        np.testing.assert_array_equal(
+            np.asarray(list(out["y"]), np.float32), ref_y)
+        rep = obs.last_pipeline_report()
+        assert rep["stage_calls"]["pad_rows"] == 3  # 5 -> 8
+        assert rep["mesh_pad_rows_max"] == 3
+        snap = obs.snapshot()
+        assert snap["frame.mesh.pad_rows"]["value"] == 3
+        assert snap["frame.mesh.pad_overhead_pct"]["value"] == \
+            pytest.approx(100.0 * 3 / 24)
+
+    def test_indivisible_batch_size_disables_fusion_not_parity(
+            self, mesh8, monkeypatch):
+        """batch_size % data-axis != 0: per-microbatch padding would
+        interleave pad rows inside a fused flatten, so fusion drops to
+        1 — and the per-batch path stays bit-exact."""
+        _clean_env(monkeypatch)
+        f = _frame(n=30)
+        jfn = jax.jit(lambda b: b.sum(axis=1))
+        ref_y = _ref(f, jfn, batch_size=6)
+        out = f.map_batches(jfn, ["x"], ["y"], batch_size=6, mesh=mesh8,
+                            fuse_steps=4, dispatch_depth=2,
+                            autotune=False)
+        np.testing.assert_array_equal(
+            np.asarray(list(out["y"]), np.float32), ref_y)
+        rep = obs.last_pipeline_report()
+        assert rep["fuse_steps"] == 1
+        assert "fused_dispatches" not in rep["stage_calls"]
+
+    def test_host_fn_under_mesh_stays_serial_and_unfused(self, mesh8,
+                                                         monkeypatch):
+        """A plain numpy fn with ``mesh=`` must NOT be jitted into a
+        fused scan (trace-time crash) nor run concurrently on the
+        window's pool threads (its in-place mutations would race):
+        the fast-path gates require a REAL device fn, same heuristic
+        as single-chip."""
+        import threading
+
+        _clean_env(monkeypatch)
+        names = []
+
+        def host_fn(b):
+            names.append(threading.current_thread().name)
+            return np.asarray(b).sum(axis=1)
+
+        f = _frame()
+        out = f.map_batches(host_fn, ["x"], ["y"], batch_size=8,
+                            mesh=mesh8, fuse_steps=4, dispatch_depth=4)
+        np.testing.assert_array_equal(
+            np.asarray(list(out["y"]), np.float32),
+            np.asarray(f["x"], np.float32).sum(axis=1))
+        rep = obs.last_pipeline_report()
+        assert rep["fuse_steps"] == 1
+        assert rep["dispatch_depth"] == 1
+        assert rep["donate"] is False
+        assert not any(n.startswith("tpudl-dispatch") for n in names)
+
+    def test_mesh_fast_path_kill_switch(self, mesh8, monkeypatch):
+        """TPUDL_MESH_FAST_PATH=0 reverts to the conservative mesh
+        executor: serial dispatch, no fusion, no donation, no autotune
+        — and the same bits."""
+        _clean_env(monkeypatch)
+        monkeypatch.setenv("TPUDL_MESH_FAST_PATH", "0")
+        f = _frame()
+        jfn = jax.jit(lambda b: (b * 2.0).sum(axis=1))
+        ref_y = _ref(f, jfn)
+        out = f.map_batches(jfn, ["x"], ["y"], batch_size=8, mesh=mesh8,
+                            fuse_steps=4, dispatch_depth=4, donate=True)
+        np.testing.assert_array_equal(
+            np.asarray(list(out["y"]), np.float32), ref_y)
+        rep = obs.last_pipeline_report()
+        assert rep["fuse_steps"] == 1
+        assert rep["dispatch_depth"] == 1
+        assert rep["donate"] is False
+        assert rep["autotune"] is False
+
+
+class TestMeshReportSurface:
+    def test_report_carries_mesh_shape_stages_and_window(self, mesh8,
+                                                         monkeypatch):
+        _clean_env(monkeypatch)
+        f = _frame(n=64)
+        jfn = jax.jit(lambda b: b * 2)
+        f.map_batches(jfn, ["x"], ["y"], batch_size=8, mesh=mesh8,
+                      dispatch_depth=3, autotune=False)
+        rep = obs.last_pipeline_report()
+        assert rep["mesh"] == {"data": 8, "model": 1}
+        assert rep["executor"] == "pipelined"
+        assert "h2d" in rep["stage_seconds"]
+        # the async window runs ON the mesh path now: the in-flight
+        # gauge and the consumer's unhidden dispatch_wait both report
+        assert "dispatch_wait" in rep["stage_seconds"]
+        assert 1 <= rep["dispatch_inflight_max"] <= 3
+        assert rep["mesh_pad_rows_max"] == 0
+        snap = obs.snapshot()
+        assert "frame.mesh.pad_rows" in snap
+
+    def test_single_chip_report_has_no_mesh_keys(self, monkeypatch):
+        _clean_env(monkeypatch)
+        f = _frame(n=16)
+        f.map_batches(jax.jit(lambda b: b * 2), ["x"], ["y"],
+                      batch_size=8, autotune=False)
+        rep = obs.last_pipeline_report()
+        assert rep["mesh"] is None
+        assert "mesh_pad_rows_max" not in rep
+
+
+def _mesh_dispatch_bound_report(batch_size, mesh_axes):
+    """A finished dispatch-bound MESH-shaped report filed into the
+    ring — the 'previous run' the autotuner seeds from on the sharded
+    path (mirrors test_frame_async._dispatch_bound_prior_report)."""
+    rep = obs.PipelineReport()
+    rep.stages = {"prepare": 1.0, "infeed_wait": 0.05, "h2d": 0.2,
+                  "dispatch": 1.9, "d2h": 0.1}
+    rep.calls = {"dispatch": 4, "prepare": 4,
+                 "bytes_prepared": int(1024 * 0.0685 * 2**20)}
+    rep.rows_done = 1024
+    rep.wall_seconds = 2.3
+    rep.finished = True
+    rep.config = {"rows": 1024, "batch_size": int(batch_size),
+                  "fuse_steps": 1, "dispatch_depth": 1,
+                  "prefetch_depth": 2, "prepare_workers": 2,
+                  "wire_codec": "u8", "executor": "pipelined",
+                  "mesh": mesh_axes}
+    obs.set_last_pipeline(rep)
+    return rep
+
+
+class TestMeshAutotune:
+    def test_sharded_report_seeds_mesh_run(self, mesh8, monkeypatch):
+        """Autotune closes the loop ON the mesh path: a dispatch-bound
+        sharded prior report seeds fuse_steps/dispatch_depth for the
+        next mesh run, matching the advisor's own recommendations."""
+        _clean_env(monkeypatch)
+        monkeypatch.setenv("TPUDL_WIRE_MBPS", "140")
+        monkeypatch.setenv("TPUDL_DEVICE_MS_PER_STEP", "34.26")
+        _mesh_dispatch_bound_report(8, {"data": 8, "model": 1})
+        rr = obs.analyze_roofline(obs.last_pipeline_report(),
+                                  publish=False)
+        advice = {r["knob"]: r["recommended"] for r in rr.advice}
+        assert advice.get("dispatch_depth", 0) > 1
+        assert advice.get("fuse_steps", 0) > 1
+
+        f = _frame(n=64)
+        out = f.map_batches(jax.jit(lambda b: b * 2), ["x"], ["y"],
+                            batch_size=8, mesh=mesh8)
+        rep = obs.last_pipeline_report()
+        assert rep["autotune"] is True
+        assert rep["dispatch_depth"] == advice["dispatch_depth"]
+        assert rep["fuse_steps"] == advice["fuse_steps"]
+        assert set(rep["autotuned"]) >= {"dispatch_depth", "fuse_steps"}
+        np.testing.assert_array_equal(
+            np.stack(list(out["y"])).astype(np.float32), f["x"] * 2)
+
+    def test_dropped_fuse_seed_not_reported_autotuned(self, mesh8,
+                                                      monkeypatch):
+        """A fuse_steps seed the mesh divisibility gate discards must
+        not be reported in `autotuned` (listed knobs carry the
+        advisor's values — a phantom entry would claim fusion ran at a
+        geometry where it can never engage)."""
+        _clean_env(monkeypatch)
+        monkeypatch.setenv("TPUDL_WIRE_MBPS", "140")
+        monkeypatch.setenv("TPUDL_DEVICE_MS_PER_STEP", "34.26")
+        _mesh_dispatch_bound_report(6, {"data": 8, "model": 1})
+        f = _frame(n=30)
+        f.map_batches(jax.jit(lambda b: b * 2), ["x"], ["y"],
+                      batch_size=6, mesh=mesh8)  # 6 % 8 != 0
+        rep = obs.last_pipeline_report()
+        assert rep["fuse_steps"] == 1
+        assert "fuse_steps" not in rep["autotuned"]
+        assert "dispatch_depth" in rep["autotuned"]  # that seed engaged
+
+    def test_topology_guard_never_cross_tunes(self, mesh8, monkeypatch):
+        """The workload guard keys on mesh shape too: a single-chip
+        prior report must not tune a sharded run (and the advisor's
+        per-dispatch numbers are per-topology quantities)."""
+        _clean_env(monkeypatch)
+        monkeypatch.setenv("TPUDL_WIRE_MBPS", "140")
+        monkeypatch.setenv("TPUDL_DEVICE_MS_PER_STEP", "34.26")
+        _mesh_dispatch_bound_report(8, None)  # single-chip shape
+        f = _frame(n=64)
+        f.map_batches(jax.jit(lambda b: b * 2), ["x"], ["y"],
+                      batch_size=8, mesh=mesh8)
+        rep = obs.last_pipeline_report()
+        assert rep["autotuned"] == []
+        assert rep["dispatch_depth"] == 2  # defaults, not the seed
+        assert rep["fuse_steps"] == 1
+
+
+@pytest.fixture(scope="module")
+def featurizer_pair(mesh8):
+    """One DeepImageFeaturizer program, single-chip and mesh — the
+    public-API parity + HLO-pin surface (ResNet50 random weights, the
+    same config the tier-1 classification test compiles)."""
+    from tpudl.image import imageIO
+    from tpudl.ml import DeepImageFeaturizer
+
+    rng = np.random.default_rng(3)
+    structs = [imageIO.imageArrayToStruct(
+        rng.integers(0, 256, size=(32, 32, 3), dtype=np.uint8))
+        for _ in range(16)]
+    frame = Frame({"image": structs})
+    single = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                                 modelName="ResNet50", batchSize=8)
+    meshed = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                                 modelName="ResNet50", batchSize=8,
+                                 mesh=mesh8)
+    return frame, single, meshed
+
+
+class TestFeaturizerMeshParity:
+    def test_public_api_mesh_matches_single(self, featurizer_pair,
+                                            monkeypatch):
+        """DeepImageFeaturizer.transform — the judged workload —
+        through the SAME public API: one sharding annotation buys data
+        parallelism without changing results. Executor-level parity is
+        bitwise (the matrix above); through the full zoo net the
+        PARTITIONED XLA program may reassociate within-row conv
+        reductions (the same f32-rounding class as DATA.md's fused-
+        prologue caveat, measured ~5e-4 relative), so this pins a
+        tight tolerance, not bytes."""
+        _clean_env(monkeypatch)
+        frame, single, meshed = featurizer_pair
+        a = np.stack(list(single.transform(frame)["f"]))
+        b = np.stack(list(meshed.transform(frame)["f"]))
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+        rep = obs.last_pipeline_report()
+        assert rep["mesh"] == {"data": 8, "model": 1}
+
+    def test_hlo_pin_featurize_is_all_gather_free(self, featurizer_pair,
+                                                  mesh8):
+        """THE HLO pin (ROADMAP 1 acceptance): the featurize program
+        lowered at a data-sharded abstract input compiles with ZERO
+        all-gathers — GSPMD partitions the per-row program instead of
+        gathering the batch (replicated weights need no collective
+        either; only ops the model itself requires may communicate)."""
+        _, _, meshed = featurizer_pair
+        jfn = meshed._get_jfn()
+        sds = jax.ShapeDtypeStruct(
+            (16, 32, 32, 3), np.uint8,
+            sharding=M.batch_sharding(mesh8, ndim=4))
+        txt = jfn.lower(sds).compile().as_text()
+        assert "all-gather" not in txt, (
+            "data-sharded featurize program contains an all-gather — "
+            "the batch is being gathered instead of partitioned")
+
+
+class TestJobsTopologyGuard:
+    def test_resume_on_different_mesh_refused(self, tmp_path, mesh8):
+        """A sharded job's manifest records its topology; a relaunch on
+        a different mesh is refused with a clear error instead of
+        silently resharding the checkpoint (ISSUE 11 satellite)."""
+        from tpudl.jobs import JobRuntime, JobSpec
+
+        def spec(mesh):
+            return JobSpec("custom", str(tmp_path),
+                           material={"m": 1}, mesh=mesh)
+
+        JobRuntime(spec(mesh8), install_signals=False).run(
+            lambda ctx: "ok")
+        # the same topology resumes fine
+        JobRuntime(spec(mesh8), install_signals=False).run(
+            lambda ctx: "ok")
+        # a different topology is refused, naming both shapes
+        with pytest.raises(ValueError, match="topology"):
+            JobRuntime(spec({"data": 4, "model": 1}),
+                       install_signals=False).run(lambda ctx: "ok")
+        # an UNKNOWN topology (spec carries none) stays permissive —
+        # the guard only fires when both sides know their mesh
+        JobRuntime(spec(None), install_signals=False).run(
+            lambda ctx: "ok")
+
+    def test_run_fit_derives_topology_from_trainer(self, tmp_path):
+        """run_fit records the Trainer's topology ({} = single-chip)
+        without the caller spelling it; a later sharded relaunch over
+        the same workdir is then refused."""
+        optax = pytest.importorskip("optax")
+        import jax.numpy as jnp
+
+        from tpudl.jobs import JobRuntime, JobSpec, load_manifest
+        from tpudl.train import Trainer
+
+        X = np.arange(32, dtype=np.float32).reshape(16, 2)
+        yv = X.sum(axis=1, keepdims=True)
+
+        def data_fn(step):
+            return X, yv
+
+        def loss_fn(p, x, t):
+            return jnp.mean((x @ p["w"] - t) ** 2)
+
+        spec = JobSpec("fit", str(tmp_path), material={"model": "lin"},
+                       save_every=2)
+        rt = JobRuntime(spec, install_signals=False)
+        rt.run_fit(Trainer(loss_fn, optax.sgd(0.01)),
+                   {"w": jnp.zeros((2, 1))}, data_fn, 3)
+        assert load_manifest(str(tmp_path))["mesh"] == {}
+        with pytest.raises(ValueError, match="topology"):
+            JobRuntime(JobSpec("fit", str(tmp_path),
+                               material={"model": "lin"}, save_every=2,
+                               mesh={"data": 8, "model": 1}),
+                       install_signals=False).run(lambda ctx: "ok")
+
+    def test_spec_claim_contradicting_trainer_mesh_refused(
+            self, tmp_path, mesh8):
+        """A spec CLAIMING a topology the Trainer does not run on is
+        refused up front — recording the claim would disarm the resume
+        guard (a {}-claiming spec over a sharded Trainer would let a
+        later topology change slip through)."""
+        optax = pytest.importorskip("optax")
+        import jax.numpy as jnp
+
+        from tpudl.jobs import JobRuntime, JobSpec
+        from tpudl.train import Trainer
+
+        def loss_fn(p, x, t):
+            return jnp.mean((x @ p["w"] - t) ** 2)
+
+        trainer = Trainer(loss_fn, optax.sgd(0.01), mesh=mesh8)
+        spec = JobSpec("fit", str(tmp_path), material={"model": "lin"},
+                       mesh={})  # claims single-chip; Trainer is 8-wide
+        with pytest.raises(ValueError, match="topology"):
+            JobRuntime(spec, install_signals=False).run_fit(
+                trainer, {"w": jnp.zeros((2, 1))},
+                lambda step: None, 1)

@@ -249,6 +249,31 @@ class TestModelEdges:
         assert rr.wire_h2d_s == pytest.approx(0.18, abs=1e-2)
         assert sum(rr.gap_attribution.values()) <= 1.0001
 
+    def test_sharded_report_gets_ranked_advice_and_mesh_inputs(self):
+        """ISSUE 11 acceptance: a data-sharded (mesh) report still gets
+        a RANKED knob verdict — dispatch_depth and fuse_steps both
+        recommended on a dispatch-bound shape (a mesh multiplies
+        compute, not the per-dispatch round-trip) — and the inputs
+        carry the topology + the measured sharded-transfer stage."""
+        rep = round45_report(
+            stage_seconds={"prepare": 1.5, "infeed_wait": 0.12,
+                           "h2d": 0.5, "dispatch": 1.9, "d2h": 0.1})
+        rep["mesh"] = {"data": 8, "model": 1}
+        rep["stage_calls"]["pad_rows"] = 24
+        rr = roofline.analyze(rep, h2d_mbps=WIRE_MBPS,
+                              device_ms_per_dispatch=DEVICE_MS,
+                              publish=False)
+        assert rr.inputs["mesh"] == {"data": 8, "model": 1}
+        assert rr.inputs["h2d_s"] == pytest.approx(0.5)
+        assert rr.inputs["pad_rows"] == 24
+        knobs = [r["knob"] for r in rr.advice]
+        assert knobs[0] in ("dispatch_depth", "fuse_steps")
+        assert {"dispatch_depth", "fuse_steps"} <= set(knobs)
+        assert rr.advice[0]["predicted_gain_pct"] > 0
+        # ranked: gains are non-increasing down the list
+        gains = [r["predicted_gain_pct"] for r in rr.advice]
+        assert gains == sorted(gains, reverse=True)
+
     def test_empty_and_meaningless_reports(self):
         assert roofline.analyze({}, publish=False) is None
         assert roofline.analyze({"stage_calls": {"dispatch": 0},

@@ -149,6 +149,18 @@ def validate_manifest(workdir: str) -> list[str]:
         if not isinstance(m.get(ts_key), _NUM):
             errs.append(f"{path}: {ts_key} missing or non-numeric")
 
+    mesh = m.get("mesh")
+    if mesh is not None:
+        # topology record (ISSUE 11): {axis: size} ({} = single-chip);
+        # the runtime refuses a resume whose topology changed, so a
+        # malformed record here would disarm that guard
+        if not isinstance(mesh, dict) or not all(
+                isinstance(k, str) and isinstance(v, int)
+                and not isinstance(v, bool) and v >= 1
+                for k, v in mesh.items()):
+            errs.append(f"{path}: mesh must be an object of "
+                        f"axis-name -> positive size, got {mesh!r}")
+
     cursor = m.get("cursor")
     if not isinstance(cursor, dict):
         errs.append(f"{path}: cursor missing or not an object")

@@ -55,6 +55,11 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_FRAME_AUTOTUNE", "bool", "1", "frame",
          "seed unset fuse_steps/dispatch_depth/prefetch_depth from the "
          "roofline advisor's recommendations (0 = off)"),
+    Knob("TPUDL_MESH_FAST_PATH", "bool", "1", "frame",
+         "0 reverts the mesh executor to the conservative pre-ISSUE-11 "
+         "path (serial blocking dispatch, blocking transfer barrier, "
+         "no fusion/donation/autotune under a mesh) — the A/B arm and "
+         "escape hatch"),
     Knob("TPUDL_FRAME_IO_WORKERS", "int", "8", "frame",
          "LazyFileColumn file-read threads"),
     Knob("TPUDL_FRAME_DECODE_WORKERS", "int", "1", "frame",
@@ -199,6 +204,8 @@ KNOBS: tuple[Knob, ...] = (
          "async-dispatch A/B sub-bench row count"),
     Knob("TPUDL_BENCH_ASYNC_DEPTH", "int", "4", "bench",
          "async-dispatch A/B sub-bench depth-D arm window size"),
+    Knob("TPUDL_BENCH_MESH_N", "int", "1024", "bench",
+         "mesh-scaling sub-bench row count (virtual 8-device child)"),
     Knob("TPUDL_BENCH_FLASH_SEQS", "str", "2048,4096,8192,16384",
          "bench", "flash-attention sub-bench sequence-length ladder"),
     Knob("TPUDL_BENCH_PREEMPT_STEPS", "int", "300", "bench",

@@ -59,11 +59,19 @@ METRICS: tuple[Metric, ...] = (
     Metric("frame.dispatch.overlap_s", "gauge",
            "dispatch seconds the in-flight window hid from the "
            "consumer (last async run)"),
+    Metric("frame.mesh.pad_rows", "gauge",
+           "rows of SPMD batch padding the last mesh run shipped and "
+           "discarded"),
+    Metric("frame.mesh.pad_overhead_pct", "gauge",
+           "pad rows as a percent of the last mesh run's dispatched "
+           "rows"),
     Metric("queue_depth", "report-gauge",
            "infeed queue depth sampled per batch (PipelineReport)"),
     Metric("dispatch_inflight", "report-gauge",
            "in-flight dispatches sampled per submit (PipelineReport; "
            "max can never exceed dispatch_depth)"),
+    Metric("mesh_pad_rows", "report-gauge",
+           "SPMD pad rows sampled per mesh batch (PipelineReport)"),
     Metric("wire_batch_bytes", "report-gauge",
            "bytes shipped per batch (PipelineReport)"),
     # -- data: codecs + shard cache ------------------------------------

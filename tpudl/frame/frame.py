@@ -359,6 +359,26 @@ def _fused_wrapper(fn: Callable, m: int, *, n_args: int | None = None,
     return fused
 
 
+def mesh_fuse_ok(batch_size: int, mesh) -> bool:
+    """Can the fused multi-step program run under ``mesh`` at this
+    batch geometry? THE one rule — shared by the executor's fuse gate
+    and ``ImageBatchWarmup`` (which must warm exactly the program
+    variant the timed transform will run): the fast path must be armed
+    and full batches must shard evenly over the data axis — a fused
+    group stacks M padded microbatches into ``(M, B_pad, ...)``, and
+    per-microbatch padding would leave pad rows INTERLEAVED in the
+    flattened output. Pick ``batch_size % data-axis == 0`` to enable
+    mesh fusion; the ragged TAIL batch always pads + dispatches
+    per-batch either way. ``mesh=None`` imposes no constraint."""
+    if mesh is None:
+        return True
+    if os.environ.get("TPUDL_MESH_FAST_PATH", "1") == "0":
+        return False
+    from tpudl import mesh as M
+
+    return int(batch_size) % mesh.shape[M.DATA_AXIS] == 0
+
+
 def _as_column(values) -> np.ndarray:
     if isinstance(values, LazyColumn):
         return values  # deferred source; materializes per access
@@ -573,10 +593,16 @@ class Frame:
         ``fn`` maps packed input arrays → one array or a tuple matching
         ``output_cols``. ``pack`` converts a column slice (object arrays
         included) to a stacked numpy batch; defaults to ``np.stack``-like
-        coercion. When ``mesh`` is given, batches are padded to the data-axis
-        size and sharded before the call (the infeed edge); outputs are
-        fetched and unpadded. This is the rebuild of the reference's
-        per-partition TensorFrames MapBlocks execution, minus the JVM.
+        coercion. When ``mesh`` is given, batches are padded to the
+        data-axis size and transferred as ONE batched async
+        ``device_put`` under ``NamedSharding(P('data'))``
+        (``tpudl.mesh.transfer_batch`` — the infeed edge); outputs are
+        fetched and unpadded, and the SAME fast path below (fusion,
+        async window, donation, codec, autotune) stays armed — no
+        parallel-only code path (``TPUDL_MESH_FAST_PATH=0`` is the
+        conservative pre-ISSUE-11 escape hatch). This is the rebuild of
+        the reference's per-partition TensorFrames MapBlocks execution,
+        minus the JVM.
 
         ``batch_size`` defaults to the frame's ``num_partitions`` hint
         (``ceil(rows / num_partitions)`` — the Spark-side meaning of a
@@ -592,18 +618,23 @@ class Frame:
         2. a ``prefetch_depth``-deep bounded infeed queue
            (``TPUDL_FRAME_PREFETCH_DEPTH``, default 2) — host RAM stays
            O(depth · batch);
-        3. multi-step fused dispatch — when ``fn`` is a jitted device fn,
-           ``mesh`` is None and batches are full-size, ``fuse_steps``
+        3. multi-step fused dispatch — when ``fn`` is a jitted device fn
+           and batches are full-size, ``fuse_steps``
            (``TPUDL_FRAME_FUSE_STEPS``, default 1 = off) microbatches are
            stacked and executed by ONE compiled ``lax.scan`` program, so
            a tunneled backend pays one dispatch round-trip per M batches
            (the per-step dispatch latency is ~93% of wall time on the
-           judged config, PROFILE.md);
+           judged config, PROFILE.md). Under a ``mesh`` the stacked
+           group transfers once with ``NamedSharding(P(None, 'data'))``
+           and each scanned microbatch runs data-sharded (fusion needs
+           ``batch_size % data-axis == 0`` there — see PIPELINE.md
+           "Mesh-native execution");
         4. a ``dispatch_depth``-deep ASYNC dispatch window
-           (``TPUDL_FRAME_DISPATCH_DEPTH``, default 2; device fns,
-           mesh=None) — up to D dispatches stay in flight as futures,
-           so the blocking per-dispatch round-trip of batch N rides
-           under the dispatches of N+1..N+D; the hot loop never calls
+           (``TPUDL_FRAME_DISPATCH_DEPTH``, default 2; device fns —
+           sharded mesh outputs are async futures too) — up to D
+           dispatches stay in flight as futures, so the blocking
+           per-dispatch round-trip of batch N rides under the
+           dispatches of N+1..N+D; the hot loop never calls
            ``block_until_ready``/``np.asarray`` on a device result.
            With ``donate`` (``TPUDL_FRAME_DONATE``, default on), fused
            and codec-wrapped programs donate their input buffers
@@ -658,22 +689,42 @@ class Frame:
         heuristic = device_fn is None
         device_flag = ((mesh is not None or _is_device_fn(fn))
                        if heuristic else bool(device_fn))
+        # the fast-path gates (fusion / window / donation / autotune)
+        # need fn to REALLY be a device fn: under a mesh device_flag is
+        # forced True (sharded inputs make prefetch/codec routing right
+        # even for host fns), but jitting a numpy fn into a fused scan
+        # would crash at trace time, and a host fn must never run
+        # concurrently on the window's pool threads (mesh=None already
+        # enforces this via device_flag — same rule, same heuristic)
+        device_fn_real = (_is_device_fn(fn) if heuristic
+                          else bool(device_fn))
         if prefetch is None:
             prefetch = device_flag
         killed = os.environ.get("TPUDL_FRAME_PREFETCH", "1") == "0"
         if killed:
             prefetch = False
+        # -- mesh fast path (ISSUE 11) ------------------------------------
+        # the mesh executor runs the SAME fast path as single-chip:
+        # fused multi-step dispatch, the async dispatch window, buffer
+        # donation, codec fusion and autotune all stay armed under a
+        # mesh. TPUDL_MESH_FAST_PATH=0 reverts to the pre-ISSUE-11
+        # conservative mesh executor (serial blocking dispatch,
+        # per-batch transfer) — the A/B arm and the escape hatch.
+        mesh_fast = (mesh is not None
+                     and os.environ.get("TPUDL_MESH_FAST_PATH", "1")
+                     != "0")
+        mesh_slow = mesh is not None and not mesh_fast
         # -- autotune: seed unset executor knobs from the advisor ---------
         # (ROADMAP 2's closed loop: fuse_steps / dispatch_depth /
         # prefetch_depth come from obs.analyze_roofline()'s ranked recs
         # over the PREVIOUS run's report + the wire probe + device
         # ms/step, instead of hand-set env knobs. Explicit kwargs and
-        # env settings always win; the serial kill switch, host fns and
-        # the mesh path never autotune.)
+        # env settings always win; the serial kill switch and host fns
+        # never autotune.)
         autotune_on = (
             (bool(autotune) if autotune is not None
              else os.environ.get("TPUDL_FRAME_AUTOTUNE", "1") != "0")
-            and not killed and device_flag and mesh is None)
+            and not killed and device_fn_real and not mesh_slow)
         seeds: dict = {}
         seeded: list[str] = []
 
@@ -695,14 +746,18 @@ class Frame:
             # read the PREVIOUS run's report before this run files its
             # own into the ring below; never probe the wire from here
             # (the cached probe / TPUDL_WIRE_MBPS is consumed if known).
-            # batch_size is the workload guard: the advisor's numbers
-            # are per-dispatch quantities at that batch geometry, and a
-            # process alternating workloads must not cross-tune them
+            # batch_size + mesh shape are the workload guard: the
+            # advisor's numbers are per-dispatch quantities at that
+            # batch geometry AND topology — a process alternating a
+            # sharded featurizer and a single-chip scorer must not
+            # cross-tune them
             from tpudl.obs import roofline as _roofline
 
             seeds = _roofline.autotune_seed(
                 allow_probe=False,
-                match={"batch_size": int(batch_size)})
+                match={"batch_size": int(batch_size),
+                       "mesh": (dict(mesh.shape) if mesh is not None
+                                else None)})
         depth = _resolve(prefetch_depth, "TPUDL_FRAME_PREFETCH_DEPTH",
                          "prefetch_depth", 2)
         workers = (int(prepare_workers) if prepare_workers is not None
@@ -710,15 +765,17 @@ class Frame:
         d_depth = max(1, _resolve(dispatch_depth,
                                   "TPUDL_FRAME_DISPATCH_DEPTH",
                                   "dispatch_depth", 2))
-        if killed or mesh is not None or not device_flag:
-            # the async window needs a device fn returning futures and
-            # no mesh sharding in the dispatch path; the kill switch
-            # must yield the fully serial executor (bench baseline arm)
+        if killed or mesh_slow or not device_fn_real:
+            # the async window needs a REAL device fn returning futures
+            # (sharded jax arrays are futures too — ISSUE 11); host fns
+            # stay serial (their in-place mutations would race on the
+            # pool), and the kill switches must yield the serial
+            # executor (bench A/B arms)
             d_depth = 1
         donate_flag = (bool(donate) if donate is not None
                        else os.environ.get("TPUDL_FRAME_DONATE", "1")
                        != "0")
-        if killed or mesh is not None or not device_flag:
+        if killed or mesh_slow or not device_fn_real:
             donate_flag = False
         if d_depth > 1 and prefetch and prefetch_depth is None and \
                 os.environ.get("TPUDL_FRAME_PREFETCH_DEPTH", "") == "" \
@@ -739,15 +796,22 @@ class Frame:
             workers = 1
         fuse = max(1, _resolve(fuse_steps, "TPUDL_FRAME_FUSE_STEPS",
                                "fuse_steps", 1))
-        if killed or mesh is not None or not device_flag:
-            # fusion stacks unsharded host batches into one jittable
-            # program: it needs a device fn and no mesh sharding, and the
-            # A/B kill switch must yield the plain serial executor
+        if killed or mesh_slow or not device_fn_real:
+            # fusion traces fn into one jitted scan program: it needs a
+            # REAL device fn (a numpy fn would crash at trace time),
+            # and the A/B kill switches must yield the serial executor
             fuse = 1
         if mesh is not None:
             from tpudl import mesh as M  # jax import only on the mesh path
 
             multiple = mesh.shape[M.DATA_AXIS]
+            if fuse > 1 and not mesh_fuse_ok(batch_size, mesh):
+                fuse = 1
+                if "fuse_steps" in seeded:
+                    # an autotune seed this geometry can never engage
+                    # must not be REPORTED as applied (the `autotuned`
+                    # contract: listed knobs carry the advisor's values)
+                    seeded.remove("fuse_steps")
         missing = [c for c in input_cols if c not in self._cols]
         if missing:
             raise KeyError(f"unknown input columns {missing}")
@@ -825,11 +889,23 @@ class Frame:
             "autotuned": sorted(seeded),
             "batch_size": int(batch_size),
             "rows": self._n,
+            # mesh topology on the report: the live monitor, the
+            # roofline model and the autotune workload guard all read
+            # it; None = single-chip
+            "mesh": dict(mesh.shape) if mesh is not None else None,
             "wire_codec": (plan.names()[0] if plan is not None
                            else "off"),
             "batch_cache": bool(cache is not None),
         }
         obs.set_last_pipeline(report)
+
+        # mesh transfer placement, captured ONCE: fuse==1 runs transfer
+        # on the prepare pool (copies start as early as possible and
+        # ride under earlier dispatches); fused runs keep host arrays
+        # and transfer the stacked (M, B, ...) group on the dispatch
+        # thread (handle()'s window-mode fallback only ever LOWERS fuse
+        # mid-run when it started > 1, so this flag never flips)
+        transfer_in_prepare = mesh is not None and fuse == 1
 
         def prepare(start, stop):
             """Pack (and, on the prefetch path, transfer) one batch.
@@ -875,8 +951,13 @@ class Frame:
                         # into fresh arrays, and without a plan no
                         # wrapper exists to carry donate_argnums — the
                         # default (donate on, no codec) keeps zero-copy
-                        # mmap replay
-                        donate_sees_hit = donate_flag and plan is not None
+                        # mmap replay. Under a mesh the transfer edge
+                        # (mesh.transfer_batch) always COPIES host
+                        # buffers into device shards, so a donating
+                        # program can never see the mmap there either.
+                        donate_sees_hit = (donate_flag
+                                           and plan is not None
+                                           and mesh is None)
                         packed = (list(hit)
                                   if device_flag and not donate_sees_hit
                                   else [np.array(a) for a in hit])
@@ -929,16 +1010,34 @@ class Frame:
                         _faults.fire("frame.h2d", index=bidx)
                         padded = [M.pad_batch(arr, multiple) for arr in packed]
                         n_pad = padded[0][1] if padded else 0
-                        packed = [M.shard_batch(p, mesh) for p, _ in padded]
-                        if prefetch:
-                            import jax
+                        packed = [p for p, _ in padded]
+                        if n_pad:
+                            report.count("pad_rows", n_pad)
+                        report.gauge("mesh_pad_rows", n_pad)
+                        if transfer_in_prepare:
+                            # ONE batched ASYNC device_put for every
+                            # column (mesh.transfer_batch) — no barrier:
+                            # the sharded arrays are futures, and the
+                            # copies land while the consumer keeps
+                            # dispatching (the old per-batch
+                            # block_until_ready serialized the pool on
+                            # the wire; the dispatch window now hides
+                            # any residual wait as dispatch_wait).
+                            # Fused runs skip this: the consumer stacks
+                            # M HOST microbatches and transfers the
+                            # (M, B, ...) group at dispatch.
+                            packed = M.transfer_batch(packed, mesh)
+                            if mesh_slow and prefetch:
+                                import jax
 
-                            # tpudl: ignore[hot-sync] — deliberate: this
-                            # barrier runs on a PREPARE-POOL thread so
-                            # the copy lands while the main thread keeps
-                            # dispatching; removing it would move the
-                            # wait INTO dispatch
-                            jax.block_until_ready(packed)  # the copy, HERE
+                                # tpudl: ignore[hot-sync] — the
+                                # TPUDL_MESH_FAST_PATH=0 escape hatch
+                                # keeps the pre-ISSUE-11 barrier: the
+                                # copy lands ON this prepare-pool
+                                # thread, so the A/B arm isolates the
+                                # new async transfer edge instead of
+                                # silently exercising it too
+                                jax.block_until_ready(packed)
                 # mesh=None: host arrays go straight into the jitted fn even
                 # when prefetching — the runtime's own arg transfer pipelines
                 # far better than an explicit device_put on tunneled/remote
@@ -965,7 +1064,11 @@ class Frame:
                     f"fn returned {len(result)} outputs, expected "
                     f"{len(output_cols)}")
             if mode is None:
-                if (heuristic and not device_flag and all(
+                # keyed on device_fn_real, not device_flag: under a
+                # mesh device_flag is forced True, but a misclassified
+                # jitted WRAPPER still loses the fast path — the hint
+                # to pass device_fn=True matters there most
+                if (heuristic and not device_fn_real and all(
                         hasattr(r, "copy_to_host_async") for r in result)):
                     _warn_device_outputs_once()
                 mode = _pick_fetch_mode(result, max(1, self._n))
@@ -1060,9 +1163,23 @@ class Frame:
             device→host copies — runs on whichever thread executes it;
             results are handled strictly in issue order."""
             def run():
+                call_args = args
+                if mesh is not None and call_args \
+                        and isinstance(call_args[0], np.ndarray):
+                    # mesh batches still host-side (fused groups, the
+                    # ragged tail of a fused run, shape-drift
+                    # fallbacks): ONE batched async transfer under the
+                    # group's NamedSharding — P(None, data, ...) for a
+                    # stacked (M, B, ...) group, P(data, ...) per batch
+                    # — on the dispatching thread, so the copy rides
+                    # inside the window like every other round-trip
+                    with report.stage("h2d"):
+                        call_args = M.transfer_batch(
+                            list(call_args), mesh,
+                            batch_dim=1 if fused else 0)
                 with report.stage("dispatch"):
                     _faults.fire("frame.dispatch", index=idx)
-                    result = call_fn(*args)
+                    result = call_fn(*call_args)
                 if not isinstance(result, (tuple, list)):
                     result = (result,)
                 # D2H starts NOW, at dispatch, for both outfeed modes —

@@ -226,6 +226,11 @@ class JobRuntime:
         self._lock = _tsan.named_lock("jobs.runtime.manifest")
         self._manifest: dict | None = None
         self._prev_sigterm = None
+        # device topology this attempt runs on ({axis: size}, {} =
+        # single-chip, None = unknown); seeded from the spec, refined
+        # by run_fit from its Trainer's mesh. _begin records it in the
+        # manifest and refuses a resume whose topology CHANGED.
+        self._mesh_axes = spec.mesh_axes
 
     # -- manifest persistence ---------------------------------------------
     def manifest_path(self) -> str:
@@ -258,6 +263,21 @@ class JobRuntime:
                 f"DIFFERENT job (manifest fingerprint "
                 f"{str(prev.get('fingerprint'))[:12]} != spec {fp[:12]}); "
                 "refusing to resume foreign state — use a fresh workdir")
+        # topology guard (ISSUE 11): a sharded checkpoint resumed on a
+        # different mesh would be silently RESHARDED (CheckpointManager
+        # restores with like=); a smaller mesh may not even hold it.
+        # Both sides must KNOW their topology for the check to fire —
+        # run_fit always does (it reads the Trainer's mesh).
+        prev_mesh = prev.get("mesh") if prev is not None else None
+        if (prev is not None and prev_mesh is not None
+                and self._mesh_axes is not None
+                and prev_mesh != self._mesh_axes):
+            raise ValueError(
+                f"workdir {self.spec.workdir} was checkpointed on mesh "
+                f"topology {prev_mesh} but this relaunch runs on "
+                f"{self._mesh_axes}; refusing to silently reshard the "
+                "resume state — relaunch on the original topology, or "
+                "start a fresh workdir to retrain on the new one")
         m = prev or {
             "schema": MANIFEST_SCHEMA, "version": MANIFEST_VERSION,
             "fingerprint": fp, "kind": self.spec.kind,
@@ -267,6 +287,11 @@ class JobRuntime:
             "trials": {"done": {}, "in_flight": [], "pending": []},
             "checkpoint": {"dir": "checkpoints", "step": None},
         }
+        if self._mesh_axes is not None:
+            # record (or backfill — a pre-topology manifest learns its
+            # mesh on the first attempt that knows it) the topology the
+            # guard above compares against
+            m["mesh"] = self._mesh_axes
         m["attempt"] = int(m.get("attempt", 0)) + 1
         m["status"] = "running"
         m["pid"] = os.getpid()
@@ -435,7 +460,27 @@ class JobRuntime:
         Trainer`) is pointed at the job's checkpoint dir and driven
         with the runtime's stop flag; the data cursor IS the step
         counter (``data_fn`` is index-addressable by the Trainer
-        contract), so one unified resume state covers model + data."""
+        contract), so one unified resume state covers model + data.
+        The Trainer's mesh (or its absence) is the attempt's topology:
+        the manifest records it and a relaunch on a different mesh is
+        refused instead of silently resharding the checkpoint. A spec
+        that CLAIMS a different topology than the Trainer actually
+        runs on is refused up front — recording the claim would
+        silently disarm the resume guard (the exact resharding it
+        exists to stop)."""
+        from tpudl.jobs.spec import mesh_axes as _mesh_axes
+
+        tmesh = getattr(trainer, "mesh", None)
+        trainer_axes = _mesh_axes(tmesh) if tmesh is not None else {}
+        if self._mesh_axes is None:
+            self._mesh_axes = trainer_axes
+        elif self._mesh_axes != trainer_axes:
+            raise ValueError(
+                f"JobSpec states mesh topology {self._mesh_axes} but "
+                f"the Trainer runs on {trainer_axes}; refusing to "
+                "record a topology the run does not use — fix the "
+                "spec's mesh= (or omit it: run_fit derives the real "
+                "one)")
 
         def payload(ctx):
             trainer.checkpoint_dir = ctx.checkpoint_dir

@@ -20,9 +20,25 @@ import hashlib
 import json
 import os
 
-__all__ = ["JobSpec", "fingerprint_material"]
+__all__ = ["JobSpec", "fingerprint_material", "mesh_axes"]
 
 KINDS = ("fit", "estimator_fit", "featurize", "hpo", "custom")
+
+
+def mesh_axes(mesh) -> dict | None:
+    """Canonical JSON form of a job's device topology: ``{axis: size}``
+    from a ``jax.sharding.Mesh`` (or a dict already in that form), or
+    ``{}`` for an explicitly single-chip run. ``None`` = topology
+    unknown/unstated (the runtime then records nothing and checks
+    nothing — ``run_fit`` derives the real topology from its Trainer).
+    Deliberately NOT part of the fingerprint: a topology change is its
+    own refusal with its own message (silently resharding a resumed
+    sharded checkpoint is the failure this exists to stop)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in sorted(mesh.items())}
+    return {str(k): int(v) for k, v in sorted(dict(mesh.shape).items())}
 
 
 def _canon(value):
@@ -73,7 +89,8 @@ class JobSpec:
     """Identity + workdir + resume knobs of one resumable job."""
 
     def __init__(self, kind: str, workdir: str, *, material: dict | None
-                 = None, save_every: int = 100, name: str | None = None):
+                 = None, save_every: int = 100, name: str | None = None,
+                 mesh=None):
         if kind not in KINDS:
             raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
         self.kind = str(kind)
@@ -81,6 +98,11 @@ class JobSpec:
         self.material = _canon(material or {})
         self.save_every = int(save_every)
         self.name = str(name) if name else self.kind
+        # the device topology this job runs on (a Mesh, {axis: size}
+        # dict, or {} for single-chip); None = unstated. The manifest
+        # records it and a resume on a DIFFERENT topology is refused
+        # (see JobRuntime._begin / mesh_axes above).
+        self.mesh_axes = mesh_axes(mesh)
 
     def fingerprint(self) -> str:
         h = hashlib.sha1()
@@ -92,13 +114,13 @@ class JobSpec:
     def to_dict(self) -> dict:
         return {"kind": self.kind, "workdir": self.workdir,
                 "material": self.material, "save_every": self.save_every,
-                "name": self.name}
+                "name": self.name, "mesh": self.mesh_axes}
 
     @classmethod
     def from_dict(cls, d: dict) -> "JobSpec":
         return cls(d["kind"], d["workdir"], material=d.get("material"),
                    save_every=int(d.get("save_every", 100)),
-                   name=d.get("name"))
+                   name=d.get("name"), mesh=d.get("mesh"))
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), sort_keys=True)

@@ -32,7 +32,9 @@ __all__ = [
     "build_mesh",
     "replicated",
     "batch_sharding",
+    "stacked_batch_sharding",
     "shard_batch",
+    "transfer_batch",
     "replicate",
     "pad_batch",
     "unpad_batch",
@@ -83,10 +85,21 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS, ndim: int = 1) -> NamedSha
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
+def stacked_batch_sharding(mesh: Mesh, axis: str = DATA_AXIS,
+                           ndim: int = 2) -> NamedSharding:
+    """Shard dim 1 (the batch dim of a stacked ``(M, B, ...)`` fused
+    group) over ``axis``; the microbatch dim and the rest replicate.
+    This is the in-sharding of the executor's fused mesh dispatch: a
+    ``lax.scan`` over dim 0 hands each microbatch to the model already
+    carrying ``P(axis, ...)``."""
+    return NamedSharding(mesh, P(None, axis, *([None] * (ndim - 2))))
+
+
 def replicate(tree, mesh: Mesh):
-    """Place every leaf on-device fully replicated (Spark broadcast analogue)."""
+    """Place every leaf on-device fully replicated (Spark broadcast
+    analogue) — ONE batched ``device_put`` for the whole tree."""
     sh = replicated(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    return jax.device_put(tree, jax.tree.map(lambda _: sh, tree))
 
 
 def pad_batch(arr: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
@@ -109,18 +122,36 @@ def unpad_batch(arr, n_pad: int):
     return arr if n_pad == 0 else arr[: arr.shape[0] - n_pad]
 
 
+def transfer_batch(tree, mesh: Mesh, axis: str = DATA_AXIS, *,
+                   batch_dim: int = 0):
+    """THE infeed transfer edge: host numpy batches → device-sharded
+    arrays, as ONE batched asynchronous ``jax.device_put`` call for the
+    whole tree (no per-leaf put, and — deliberately — no barrier: the
+    returned arrays are futures, like every other jax dispatch, so the
+    copies ride under whatever the caller does next; the executor's
+    dispatch window and the runtime hide the wait).
+
+    ``batch_dim`` selects which dim shards over ``axis``: 0 for a plain
+    batch (``P(axis, ...)``), 1 for a stacked fused group
+    (``P(None, axis, ...)`` — see :func:`stacked_batch_sharding`).
+    Leaves must already be padded to a multiple of the axis size at
+    that dim. Every mesh transfer in the codebase goes through here
+    (``Frame.map_batches``, the estimator's sub-mesh trials,
+    ``Trainer.fit`` — one path, no second ``device_put`` route to
+    drift)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.asarray(x) for x in leaves]
+    shardings = [
+        (stacked_batch_sharding(mesh, axis, a.ndim) if batch_dim == 1
+         else batch_sharding(mesh, axis, a.ndim))
+        for a in arrs]
+    return jax.tree.unflatten(treedef, jax.device_put(arrs, shardings))
+
+
 def shard_batch(tree, mesh: Mesh, axis: str = DATA_AXIS):
-    """device_put every leaf with its leading dim sharded over ``axis``.
-
-    This is the infeed edge: host numpy batches → device-sharded arrays.
-    Leaves must already be padded to a multiple of the axis size.
-    """
-
-    def _put(x):
-        x = np.asarray(x)
-        return jax.device_put(x, batch_sharding(mesh, axis, x.ndim))
-
-    return jax.tree.map(_put, tree)
+    """``transfer_batch`` with the leading dim sharded — kept as the
+    short spelling every training/estimator call site uses."""
+    return transfer_batch(tree, mesh, axis)
 
 
 @contextlib.contextmanager

@@ -284,6 +284,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                         idx = np.concatenate([idx, fill])
                     xb, yb = X[idx], y[idx]
                     if submesh is not None:
+                        # one batched async transfer for the step pair,
+                        # through THE mesh transfer edge
+                        # (mesh.transfer_batch — no second device_put
+                        # path to drift from the frame executor's)
                         xb, yb = M.shard_batch((xb, yb), submesh)
                     elif devs is not None:
                         xb, yb = jax.device_put((xb, yb), devs[0])

@@ -119,8 +119,7 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                 frame[self.getInputCol()])
         out = frame.map_batches(
             jfn, [self.getInputCol()], [out_col],
-            batch_size=self.batchSize, mesh=self.mesh, pack=pack,
-            **opts)
+            batch_size=self.batchSize, pack=pack, **opts)
         if mode == "image":
             structs = [
                 imageIO.imageArrayToStruct(np.asarray(a, dtype=np.float32))

@@ -168,8 +168,8 @@ class _NamedImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
         jfn = self._get_jfn()
         return frame.map_batches(
             jfn, [self.getInputCol()], [out_col],
-            batch_size=self.batchSize, mesh=self.mesh,
-            pack=_pack_image_structs, **self._pipeline_opts())
+            batch_size=self.batchSize, pack=_pack_image_structs,
+            **self._pipeline_opts())
 
 
 class DeepImageFeaturizer(_NamedImageTransformer):

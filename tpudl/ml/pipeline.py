@@ -72,13 +72,16 @@ class Transformer(Params):
         """The ``Frame.map_batches`` pipelined-executor knobs every
         batch transformer plumbs through: prefetch depth (K), prepare
         workers (N), fused dispatch steps (M), the async dispatch
-        window depth (D — PIPELINE.md "Async dispatch"), plus the
+        window depth (D — PIPELINE.md "Async dispatch"), the device
+        ``mesh`` (data-parallel GSPMD sharding — the mesh path runs the
+        SAME fast path, PIPELINE.md "Mesh-native execution"), plus the
         tpudl.data knobs — wire codec and prepared-batch cache dir
         (DATA.md). None = resolve from the ``TPUDL_FRAME_*`` /
         ``TPUDL_WIRE_CODEC`` / ``TPUDL_DATA_CACHE_DIR`` env knobs /
         autotune / defaults inside map_batches, so a transformer that
         never sets them still rides the pipeline."""
         return {
+            "mesh": getattr(self, "mesh", None),
             "prefetch_depth": getattr(self, "prefetchDepth", None),
             "prepare_workers": getattr(self, "prepareWorkers", None),
             "fuse_steps": getattr(self, "fuseSteps", None),

@@ -56,34 +56,40 @@ class ImageBatchWarmup:
         jfn = self._get_jfn()
         x = np.zeros((self.batchSize, height, width, nChannels),
                      dtype=dtype)
-        if self.mesh is not None:
+        mesh = self.mesh
+        if mesh is not None:
             from tpudl import mesh as M
 
-            x, _ = M.pad_batch(x, self.mesh.shape[M.DATA_AXIS])
-            x = M.shard_batch(x, self.mesh)
-        jax.block_until_ready(jfn(x))  # compile + execute; never fetched
-        if self.mesh is None:
-            # the executor will run the FUSED multi-step program when
-            # fuse_steps > 1 — warm that compile here too (compiles
-            # don't fetch, and a mid-transform compile would land
-            # inside the timed window)
-            import os as _os
+            x_pad, _ = M.pad_batch(x, mesh.shape[M.DATA_AXIS])
+            warm_in = M.transfer_batch([x_pad], mesh)[0]
+        else:
+            warm_in = x
+        jax.block_until_ready(jfn(warm_in))  # compile+execute; unfetched
+        # the executor will run the FUSED multi-step program when
+        # fuse_steps > 1 — warm that compile too (compiles don't
+        # fetch, and a mid-transform compile would land inside the
+        # timed window). The mesh path fuses only when the batch
+        # shards evenly and the fast path is armed (map_batches'
+        # own rule) — warm exactly the variant it will run.
+        import os as _os
 
-            from tpudl.frame import frame as _frame
+        from tpudl.frame import frame as _frame
 
-            fuse = getattr(self, "fuseSteps", None)
-            if fuse is None:
-                fuse = _frame._env_int("TPUDL_FRAME_FUSE_STEPS", 1)
-            if (int(fuse) > 1
-                    and _os.environ.get("TPUDL_FRAME_PREFETCH", "1") != "0"):
-                # match the executor's donation setting, or this warms
-                # a program variant the timed window never runs
-                donate = (_os.environ.get("TPUDL_FRAME_DONATE", "1")
-                          != "0")
-                fused = _frame._fused_wrapper(jfn, int(fuse), n_args=1,
-                                              donate=donate)
-                xs = np.zeros((int(fuse),) + x.shape, dtype=dtype)
-                jax.block_until_ready(fused(xs))
+        fuse = getattr(self, "fuseSteps", None)
+        if fuse is None:
+            fuse = _frame._env_int("TPUDL_FRAME_FUSE_STEPS", 1)
+        if (int(fuse) > 1 and _frame.mesh_fuse_ok(self.batchSize, mesh)
+                and _os.environ.get("TPUDL_FRAME_PREFETCH", "1") != "0"):
+            # match the executor's donation setting, or this warms
+            # a program variant the timed window never runs
+            donate = (_os.environ.get("TPUDL_FRAME_DONATE", "1")
+                      != "0")
+            fused = _frame._fused_wrapper(jfn, int(fuse), n_args=1,
+                                          donate=donate)
+            xs = np.zeros((int(fuse),) + x.shape, dtype=dtype)
+            if mesh is not None:
+                xs = M.transfer_batch([xs], mesh, batch_dim=1)[0]
+            jax.block_until_ready(fused(xs))
         return self
 
 
@@ -181,8 +187,7 @@ class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
         jfn = self._get_jfn()
         out = frame.map_batches(
             jfn, [in_col], [out_col], batch_size=self.batchSize,
-            mesh=self.mesh, pack=_pack_image_structs,
-            **self._pipeline_opts())
+            pack=_pack_image_structs, **self._pipeline_opts())
         if mode == "image":
             structs = [
                 imageIO.imageArrayToStruct(np.asarray(a, dtype=np.float32))

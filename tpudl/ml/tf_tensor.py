@@ -77,5 +77,5 @@ class TFTransformer(Transformer):
         jfn = self._cached_jit(
             (gin, tuple(feeds), tuple(fetches)), build)
         return frame.map_batches(jfn, in_cols, out_cols,
-                                 batch_size=self.batchSize, mesh=self.mesh,
+                                 batch_size=self.batchSize,
                                  **self._pipeline_opts())

@@ -46,9 +46,11 @@ class PipelineReport:
 
     - ``prepare``: worker-thread seconds in decode/pack (summed across
       the prepare pool — N workers can make this exceed wall time);
-    - ``h2d``: the explicit shard + host→device transfer inside prepare
-      (mesh path only; on the mesh=None tunnel path the transfer rides
-      the dispatch, see map_batches);
+    - ``h2d``: the explicit pad + sharded-transfer ENQUEUE on the mesh
+      path (``mesh.transfer_batch`` is async since ISSUE 11 — the
+      copies themselves ride under later dispatches, so this stage
+      measures the enqueue/pad cost, not the wire; on the mesh=None
+      tunnel path the transfer rides the dispatch, see map_batches);
     - ``dispatch``: seconds in ``fn(...)`` — on the serial path these
       are consumer-thread seconds (enqueue only for async device fns,
       enqueue+compute for host fns); under the D-deep async dispatch
@@ -205,6 +207,16 @@ class PipelineReport:
         if inflight is not None:
             _metrics.gauge("frame.dispatch.inflight").set(
                 inflight.to_dict()["mean"])
+        # mesh-path waste accounting (ISSUE 11): rows of SPMD padding
+        # this run shipped and computed only to throw away — the
+        # mesh_scaling bench and the roofline read these
+        if self.config.get("mesh"):
+            with self._lock:
+                pad = int(self.calls.get("pad_rows", 0))
+            _metrics.gauge("frame.mesh.pad_rows").set(pad)
+            if rows:
+                _metrics.gauge("frame.mesh.pad_overhead_pct").set(
+                    100.0 * pad / (int(rows) + pad))
         _metrics.get_registry().maybe_flush()
 
     def report(self) -> dict:

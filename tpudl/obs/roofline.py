@@ -280,6 +280,14 @@ def analyze(report: dict | None = None, *,
             "prepare_workers": report.get("prepare_workers"),
             "wire_codec": report.get("wire_codec"),
             "batch_size": report.get("batch_size"),
+            # mesh topology + the measured sharded-transfer stage
+            # (ISSUE 11): the advisor's dispatch_depth/fuse_steps recs
+            # apply unchanged to sharded reports — a mesh multiplies
+            # compute, not the per-dispatch round-trip, so on a
+            # wire-bound tunnel overlap matters MORE per chip
+            "mesh": report.get("mesh"),
+            "h2d_s": explicit_h2d or None,
+            "pad_rows": calls.get("pad_rows"),
         })
     rr.advice = advise(rr)
     rr.verdict = _verdict(rr)

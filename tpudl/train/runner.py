@@ -452,7 +452,10 @@ class Trainer:
                         D.global_batch(np.asarray(b), self.mesh)
                         for b in batch)
                 elif shard_inputs:
-                    batch = tuple(M.shard_batch(b, self.mesh) for b in batch)
+                    # ONE batched async transfer for the whole step
+                    # tuple (mesh.transfer_batch underneath — the same
+                    # edge the frame executor and the estimator use)
+                    batch = M.shard_batch(batch, self.mesh)
                 params, opt_state, loss = step_fn(params, opt_state, *batch)
                 step_hist.observe(time.perf_counter() - t_step)
                 step_gauge.set(step + 1)
