@@ -324,6 +324,13 @@ def _compact_summary(record: dict) -> dict:
             # the tpudl.data one-line evidence: u8 ships ~4x fewer
             # bytes; a warm epoch reads ZERO files
             s[k] = _scalar(dp[k])
+    dc = record.get("device_cache") or {}
+    for k in ("hbm_warm_speedup", "hbm_epoch2_bytes_shipped"):
+        if dc.get(k) is not None:
+            # the ISSUE-12 one-liners: epoch-2 resident over epoch-1
+            # cold, and the hard zero-wire claim (epoch-2 wire bytes
+            # MUST read 0 — any other value is a residency regression)
+            s[k] = _scalar(dc[k])
     ad = record.get("async_dispatch") or {}
     for k in ("async_speedup", "dispatch_overlap_pct"):
         if ad.get(k) is not None:
@@ -1416,6 +1423,76 @@ def measure_data_pipeline():
     return out
 
 
+def measure_device_cache():
+    """device-cache sub-bench (DATA.md "Cache hierarchy", ISSUE 12):
+    the SAME u8-encoded featurize-shaped program over the SAME frame,
+    epoch 1 cold (batches ship + become HBM-resident) vs epoch 2 warm
+    (every batch served from device memory — ZERO wire bytes, asserted
+    off the data.wire.bytes_shipped counter). Emits ``hbm_warm_speedup``
+    (warm over cold — a within-round ratio, scored raw by
+    bench_sentinel like async_speedup) and ``hbm_epoch2_bytes_shipped``
+    (the hard zero-wire claim) onto the judged summary line."""
+    import jax
+
+    from tpudl import obs
+    from tpudl.data import device_cache as _dc
+    from tpudl.frame import Frame
+
+    n = int(os.environ.get("TPUDL_BENCH_HBM_N", "512"))
+    batch = 64
+    h = w = 96
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(n, h, w, 3), dtype=np.uint8)
+    frame = Frame({"x": x})
+    # wire-shaped on purpose: light compute, image-sized inputs — the
+    # epoch difference is the H2D transfer residency removes
+    fn = jax.jit(lambda b: b.reshape(b.shape[0], -1).mean(axis=1))
+    out = {"n": n, "image_hw": h, "batch": batch}
+
+    def one_pass():
+        before = obs.snapshot()
+        t0 = time.perf_counter()
+        res = frame.map_batches(fn, ["x"], ["y"], batch_size=batch,
+                                wire_codec="u8", device_cache=True,
+                                autotune=False)
+        np.asarray(res["y"])  # materialized
+        dt = time.perf_counter() - t0
+        after = obs.snapshot()
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        return n / dt, delta("data.wire.bytes_shipped"), \
+            delta("data.hbm.hits")
+
+    _dc.reset_device_cache()  # this sub-bench owns a cold epoch 1
+    one_pass()  # compile the wrapped program outside timing (still
+    _dc.reset_device_cache()  # populates — reset back to cold)
+    cold_rate, cold_shipped, _ = one_pass()
+    warm_rates = []
+    warm_shipped = warm_hits = 0
+    for _t in range(3):
+        r, shipped, hits = one_pass()
+        warm_rates.append(r)
+        warm_shipped += shipped
+        warm_hits += hits
+    warm_rate = statistics.median(warm_rates)
+    out["cold_images_per_sec"] = round(cold_rate, 1)
+    out["warm_images_per_sec"] = round(warm_rate, 1)
+    out["hbm_epoch1_bytes_shipped"] = int(cold_shipped)
+    out["hbm_epoch2_bytes_shipped"] = int(warm_shipped)  # contract: 0
+    out["hbm_warm_hits"] = int(warm_hits)
+    if cold_rate > 0:
+        out["hbm_warm_speedup"] = round(warm_rate / cold_rate, 2)
+    out["hbm_bytes_resident"] = int(
+        _dc.get_device_cache().bytes_resident)
+    log(f"device cache epochs ({n} imgs): cold {cold_rate:.1f} vs warm "
+        f"{warm_rate:.1f} img/s -> {out.get('hbm_warm_speedup')}x "
+        f"(epoch-2 wire bytes {warm_shipped})")
+    return out
+
+
 def measure_async_dispatch():
     """async-dispatch A/B sub-bench (PIPELINE.md "Async dispatch"): the
     SAME jitted featurize-shaped reduction over the SAME frame, blocking
@@ -2172,7 +2249,7 @@ def main():
         # tunnel weather INSIDE the same record
         probed = {"horovod_resnet50", "predictor_resnet50",
                   "estimator_inception", "data_pipeline",
-                  "async_dispatch"}
+                  "async_dispatch", "device_cache"}
         for key, fn in [("horovod_resnet50", lambda: measure_train_step(dtype)),
                         ("predictor_resnet50", lambda: measure_predictor(dtype)),
                         ("keras_transformer_mlp", measure_keras_transformer),
@@ -2180,6 +2257,7 @@ def main():
                         ("estimator_inception", measure_estimator_inception),
                         ("decode", measure_decode),
                         ("data_pipeline", measure_data_pipeline),
+                        ("device_cache", measure_device_cache),
                         ("async_dispatch", measure_async_dispatch),
                         ("mesh_scaling", measure_mesh_scaling),
                         ("preemption", measure_preemption),

@@ -991,6 +991,28 @@ class TestTsanRuntime:
         bad = [f for f in tsan.findings() if f["kind"] == "lockset"]
         assert len(bad) == 1
 
+    def test_check_guarded_muted_during_reporting_hop(self, armed):
+        """The sanitizer never reports its own reporting path:
+        _file_finding's metrics hop may REGISTER a fresh tsan.* counter
+        (mutating the metrics registry map) while the registry's guard
+        is a pre-arming plain Lock that held() cannot see — that probe
+        must be muted, or the process's FIRST lockset finding grows a
+        spurious metrics-registry sibling (order-dependent: whichever
+        test module armed the registry first)."""
+        st = tsan._state()
+        tsan.named_lock("obs.metrics.registry")  # name known to st
+        st.tls.reporting = True
+        try:
+            tsan.check_guarded("obs.metrics.registry", "map")
+        finally:
+            st.tls.reporting = False
+        assert [f for f in tsan.findings()
+                if f["kind"] == "lockset"] == []
+        # and outside the hop the same probe still fires
+        tsan.check_guarded("obs.metrics.registry", "map")
+        assert len([f for f in tsan.findings()
+                    if f["kind"] == "lockset"]) == 1
+
     def test_lockset_violation_and_pass(self, armed):
         lk = tsan.named_lock("fix.guard")
         with lk:

@@ -1,0 +1,805 @@
+"""HBM-tier device batch cache (ISSUE 12 tentpole) — tier-1, NOT slow.
+
+The cache-hierarchy endgame's own acceptance bar, on the simulated
+8-device CPU mesh where it must:
+
+1. PARITY — ``map_batches`` with the device cache armed is bitwise
+   identical to the cache-off run across the depth × donate × fuse
+   matrix, single-chip AND sharded over the virtual mesh;
+2. ZERO-WIRE WARM EPOCHS — epoch 2 of a run (map_batches replay,
+   Dataset epoch iteration, a 2-epoch ``Trainer.fit``) ships exactly 0
+   bytes (``data.wire.bytes_shipped`` delta == 0) and serves every
+   batch from HBM (``data.hbm.hits`` == batch count), via the metrics
+   registry;
+3. EVICTION / RESTART — LRU eviction under a tiny budget mid-run is
+   transparent (re-transfer, no error); a process restart (cold cache)
+   falls back to the PR-4 shard cache (zero decodes, bytes re-shipped
+   once); a different mesh topology is a key MISS, never a reshard;
+4. DONATION — resident buffers are never donated: a hit replayed after
+   a donating run is still valid, and ``data.hbm.donation_blocked``
+   counts the non-donating fallback;
+5. OBS — the roofline subtracts resident-hit bytes from its wire
+   attribution, its advisor recommends ``device_cache`` on wire-bound
+   fitting runs, and the live status plane carries the residency line.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpudl import mesh as M
+from tpudl import obs
+from tpudl.data import device_cache as dc
+from tpudl.frame import Frame
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _snap(name: str) -> float:
+    return obs.snapshot().get(name, {}).get("value", 0) or 0
+
+
+def _clean_env(monkeypatch):
+    for var in ("TPUDL_FRAME_PREFETCH", "TPUDL_FRAME_PREFETCH_DEPTH",
+                "TPUDL_FRAME_PREPARE_WORKERS", "TPUDL_FRAME_FUSE_STEPS",
+                "TPUDL_FRAME_DISPATCH_DEPTH", "TPUDL_FRAME_DONATE",
+                "TPUDL_FRAME_AUTOTUNE", "TPUDL_MESH_FAST_PATH",
+                "TPUDL_WIRE_CODEC", "TPUDL_DATA_CACHE_DIR",
+                "TPUDL_DATA_DEVICE_CACHE", "TPUDL_DATA_HBM_BUDGET_MB",
+                "TPUDL_WIRE_MBPS", "TPUDL_DEVICE_MS_PER_STEP"):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    dc.reset_device_cache()
+    yield
+    dc.reset_device_cache()
+
+
+def _frame(n=48, cols=6, seed=7):
+    rng = np.random.default_rng(seed)
+    return Frame({"x": rng.integers(
+        0, 256, size=(n, cols)).astype(np.float32)})
+
+
+def _jfn():
+    return jax.jit(lambda b: (b * 3.0 + 0.5).sum(axis=1))
+
+
+def _ref(f, jfn, batch_size=8):
+    out = f.map_batches(jfn, ["x"], ["y"], batch_size=batch_size,
+                        prefetch=False, dispatch_depth=1, donate=False,
+                        autotune=False)
+    return np.asarray(list(out["y"]), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# cache mechanics (no executor)
+# ---------------------------------------------------------------------------
+
+class TestDeviceBatchCacheUnit:
+    def _arrs(self, nbytes: int):
+        return [np.zeros(nbytes, np.uint8)]
+
+    def test_put_get_lru_and_bytes(self):
+        c = dc.DeviceBatchCache(budget=1000)
+        for i in range(3):
+            pin = c.put(("k", i), self._arrs(200))
+            assert pin is not None
+            pin.release()
+        assert c.bytes_resident == 600
+        assert len(c) == 3
+        hit = c.get(("k", 1))
+        assert hit is not None and hit.nbytes == 200
+        hit.release()
+        assert c.get(("k", 99)) is None
+
+    def test_cross_run_eviction_is_lru(self):
+        c = dc.DeviceBatchCache(budget=500)
+        for i in range(2):
+            c.put(("a", i), self._arrs(200)).release()
+        c.get(("a", 0)).release()  # touch 0: ("a", 1) becomes LRU
+        ev0 = _snap("data.hbm.evictions")
+        c.put(("b", 0), self._arrs(200)).release()  # another run
+        assert _snap("data.hbm.evictions") - ev0 == 1
+        assert c.get(("a", 1)) is None       # the LRU victim
+        c.get(("a", 0)).release()            # the touched entry survives
+        assert c.bytes_resident == 400
+
+    def test_same_run_never_evicts_itself(self):
+        """A sequential scan bigger than the budget keeps its PREFIX
+        resident instead of LRU-thrashing itself: the tail is refused
+        (would_fit says so up front — no doomed device copies), and
+        nothing of the run's own head is evicted."""
+        c = dc.DeviceBatchCache(budget=500)
+        for i in range(2):
+            c.put(("a", i), self._arrs(200)).release()
+        ev0 = _snap("data.hbm.evictions")
+        assert not c.would_fit(200, run="a")  # admission says no...
+        assert c.put(("a", 2), self._arrs(200)) is None  # ...put agrees
+        assert _snap("data.hbm.evictions") - ev0 == 0
+        for i in range(2):  # the head stays resident
+            c.get(("a", i)).release()
+        assert c.would_fit(200, run="b")  # another run could still evict
+
+    def test_put_same_key_dedupes_onto_existing_entry(self):
+        """Two concurrent runs missing the same batch: the second put
+        returns a pin on the EXISTING entry instead of popping a
+        predecessor whose in-flight buffers would fall out of the byte
+        accounting."""
+        c = dc.DeviceBatchCache(budget=1000)
+        p1 = c.put(("k", 0), self._arrs(200))
+        puts0 = _snap("data.hbm.puts")
+        p2 = c.put(("k", 0), self._arrs(200))
+        assert _snap("data.hbm.puts") - puts0 == 0  # dedup, not a put
+        assert p2._entry is p1._entry
+        assert c.bytes_resident == 200
+        assert c._entries[("k", 0)].pins == 2
+        p1.release()
+        p2.release()
+
+    def test_pinned_entries_never_evict(self):
+        c = dc.DeviceBatchCache(budget=500)
+        pin = c.put(("a", 0), self._arrs(300))  # stays pinned
+        assert pin is not None
+        # ("a", 0) is pinned: another run's 300B put cannot fit and
+        # must NOT be stored (would_fit agrees)
+        assert not c.would_fit(300, run="b")
+        assert c.put(("b", 0), self._arrs(300)) is None
+        assert c.get(("a", 0)) is not None
+        pin.release()
+
+    def test_budget_zero_means_zero(self, monkeypatch):
+        """An explicit TPUDL_DATA_HBM_BUDGET_MB=0 forbids residency —
+        never silently replaced by the default budget."""
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB", "0")
+        c = dc.DeviceBatchCache()
+        assert c.budget == 0
+        assert c.put(("k", 0), self._arrs(1)) is None
+        assert c.bytes_resident == 0
+
+    def test_oversized_entry_refused_not_fatal(self):
+        c = dc.DeviceBatchCache(budget=100)
+        assert c.put(("k", 0), self._arrs(500)) is None
+        assert c.bytes_resident == 0
+
+    def test_release_idempotent_per_token(self):
+        c = dc.DeviceBatchCache(budget=1000)
+        pin = c.put(("k", 0), self._arrs(10))
+        other = c.get(("k", 0))  # a second concurrent pin
+        pin.release()
+        pin.release()  # double release of ONE token: no double decrement
+        assert c._entries[("k", 0)].pins == 1
+        other.release()
+        assert c._entries[("k", 0)].pins == 0
+
+    def test_budget_env_override(self, monkeypatch):
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB", "3")
+        assert dc.budget_bytes() == 3 << 20
+        monkeypatch.delenv("TPUDL_DATA_HBM_BUDGET_MB")
+        assert dc.budget_bytes() >= 1 << 20  # derived or default
+
+    def test_run_key_carries_topology_and_device_identity(self, mesh8):
+        single = dc.run_key("abc", None)
+        sharded = dc.run_key("abc", mesh8)
+        assert single != sharded
+        assert "data=8" in sharded
+        assert dc.run_key("abc", mesh8) == sharded  # stable
+        # same SHAPE over a different device slice is a different key:
+        # a replay would silently run on the wrong devices otherwise
+        devs = jax.devices()
+        m_a = M.build_mesh(n_data=4, devices=devs[:4])
+        m_b = M.build_mesh(n_data=4, devices=devs[4:8])
+        assert dc.run_key("abc", m_a) != dc.run_key("abc", m_b)
+
+    def test_bulk_resident_budget_rehit_and_release(self, monkeypatch):
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB", "1")
+        dc.reset_device_cache()
+        X = np.zeros((100, 10), np.float32)
+        key = (f"bulk|{dc.array_token(X)}", 0)
+        pin = dc.bulk_resident(key, (X,))
+        assert pin is not None
+        again = dc.bulk_resident(key, (X,))
+        assert again.arrays[0] is pin.arrays[0]  # resident rehit
+        again.release()
+        # a bulk past the budget is refused, never crashes
+        big = np.zeros((1 << 19,), np.float32)  # 2 MB > 1 MB budget
+        assert dc.bulk_resident((f"bulk|{dc.array_token(big)}", 0),
+                                (big,)) is None
+        # a RELEASED finished bulk is LRU prey for the next dataset:
+        # no cross-dataset HBM stranding. Re-place X at ~0.7 MB so the
+        # next ~0.7 MB bulk cannot fit beside it in the 1 MB budget.
+        dc.get_device_cache().clear()
+        Xbig = np.zeros((180_000,), np.float32)  # ~720 KB
+        key_x = (f"bulk|{dc.array_token(Xbig)}", 0)
+        pin_x = dc.bulk_resident(key_x, (Xbig,))
+        assert pin_x is not None
+        pin_x.release()  # the fit finished
+        Z = np.ones((180_000,), np.float32)
+        pin_z = dc.bulk_resident((f"bulk|{dc.array_token(Z)}", 0), (Z,))
+        assert pin_z is not None  # evicted X's released bulk to fit
+        assert dc.get_device_cache().get(key_x) is None
+        pin_z.release()
+
+    def test_array_token_memoized_per_object(self):
+        X = np.zeros((64, 8), np.float32)
+        t1 = dc.array_token(X)
+        assert dc.array_token(X) == t1  # memo hit, same token
+        assert id(X) in dc._TOKEN_MEMO
+        Y = X.copy()
+        Y[0, 0] = 1.0
+        assert dc.array_token(Y) != t1  # content still keys identity
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity (acceptance: depth × donate × fuse, single + mesh)
+# ---------------------------------------------------------------------------
+
+class TestBitwiseParity:
+    def test_matrix_single_chip(self, monkeypatch):
+        _clean_env(monkeypatch)
+        f = _frame()
+        jfn = _jfn()
+        ref_y = _ref(f, jfn)
+        for depth in (1, 4):
+            for donate in (False, True):
+                for fuse in (1, 4):
+                    dc.reset_device_cache()
+                    for epoch in range(2):  # populate, then replay
+                        out = f.map_batches(
+                            jfn, ["x"], ["y"], batch_size=8,
+                            wire_codec="u8", device_cache=True,
+                            dispatch_depth=depth, donate=donate,
+                            fuse_steps=fuse, autotune=False)
+                        np.testing.assert_array_equal(
+                            np.asarray(list(out["y"]), np.float32),
+                            ref_y,
+                            err_msg=f"single depth={depth} "
+                                    f"donate={donate} fuse={fuse} "
+                                    f"epoch={epoch}")
+                    rep = obs.last_pipeline_report()
+                    assert rep["device_cache"] is True
+                    # residency forces fusion off (documented)
+                    assert rep["fuse_steps"] == 1
+
+    def test_matrix_mesh8(self, mesh8, monkeypatch):
+        _clean_env(monkeypatch)
+        f = _frame()
+        jfn = _jfn()
+        ref_y = _ref(f, jfn)
+        for depth in (1, 4):
+            for donate in (False, True):
+                for fuse in (1, 4):
+                    dc.reset_device_cache()
+                    for epoch in range(2):
+                        out = f.map_batches(
+                            jfn, ["x"], ["y"], batch_size=8, mesh=mesh8,
+                            wire_codec="u8", device_cache=True,
+                            dispatch_depth=depth, donate=donate,
+                            fuse_steps=fuse, autotune=False)
+                        np.testing.assert_array_equal(
+                            np.asarray(list(out["y"]), np.float32),
+                            ref_y,
+                            err_msg=f"mesh depth={depth} "
+                                    f"donate={donate} fuse={fuse} "
+                                    f"epoch={epoch}")
+                    rep = obs.last_pipeline_report()
+                    assert rep["mesh"] == {"data": 8, "model": 1}
+                    assert rep["device_cache"] is True
+
+    def test_no_codec_parity_and_replay(self, monkeypatch):
+        """Residency without a wire codec (plan=None): resident f32
+        batches feed the bare jitted fn, bitwise, both epochs."""
+        _clean_env(monkeypatch)
+        f = _frame()
+        jfn = _jfn()
+        ref_y = _ref(f, jfn)
+        for epoch in range(2):
+            out = f.map_batches(jfn, ["x"], ["y"], batch_size=8,
+                                device_cache=True, autotune=False)
+            np.testing.assert_array_equal(
+                np.asarray(list(out["y"]), np.float32), ref_y)
+
+    def test_env_armed_degrades_on_unfingerprintable_frame(
+            self, monkeypatch):
+        """The process-wide TPUDL_DATA_DEVICE_CACHE=1 accelerator must
+        never turn a working uncached run into a crash: a lazy column
+        with no content fingerprint silently disarms residency (plain
+        wire transfer). The EXPLICIT device_cache=True kwarg keeps the
+        clear pass-cache_key error."""
+        _clean_env(monkeypatch)
+        from tpudl.frame.frame import LazyColumn
+
+        class NoFp(LazyColumn):
+            def __init__(self, arrs):
+                self._a = arrs
+
+            def __len__(self):
+                return len(self._a)
+
+            def _get(self, idx):
+                out = np.empty(len(idx), dtype=object)
+                out[:] = [self._a[i] for i in idx]
+                return out
+
+        rng = np.random.default_rng(0)
+        f = Frame({"x": NoFp([rng.random(4).astype(np.float32)
+                              for _ in range(16)])})
+        jfn = jax.jit(lambda b: b.sum(axis=1))
+        monkeypatch.setenv("TPUDL_DATA_DEVICE_CACHE", "1")
+        out = f.map_batches(jfn, ["x"], ["y"], batch_size=8,
+                            autotune=False)  # must not raise
+        assert len(out["y"]) == 16
+        assert obs.last_pipeline_report()["device_cache"] is False
+        with pytest.raises(ValueError, match="cache_key"):
+            f.map_batches(jfn, ["x"], ["y"], batch_size=8,
+                          device_cache=True, autotune=False)
+
+    def test_host_fn_never_arms(self, monkeypatch):
+        """A host fn's inputs must stay numpy — the device cache is
+        silently disarmed (same contract as fusion/donation)."""
+        _clean_env(monkeypatch)
+        f = _frame()
+        out = f.map_batches(lambda b: np.asarray(b).sum(axis=1),
+                            ["x"], ["y"], batch_size=8,
+                            device_cache=True)
+        rep = obs.last_pipeline_report()
+        assert rep["device_cache"] is False
+        assert len(out["y"]) == len(f)
+
+
+# ---------------------------------------------------------------------------
+# zero-wire warm epochs (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestZeroWireWarmEpochs:
+    def test_map_batches_epoch2_ships_zero(self, monkeypatch):
+        _clean_env(monkeypatch)
+        f = _frame(n=48)
+        jfn = _jfn()
+        kw = dict(batch_size=8, wire_codec="u8", device_cache=True,
+                  autotune=False)
+        f.map_batches(jfn, ["x"], ["y"], **kw)  # epoch 1: populate
+        shipped0 = _snap("data.wire.bytes_shipped")
+        hits0 = _snap("data.hbm.hits")
+        f.map_batches(jfn, ["x"], ["y"], **kw)  # epoch 2: resident
+        assert _snap("data.wire.bytes_shipped") - shipped0 == 0
+        assert _snap("data.hbm.hits") - hits0 == 6  # == batch count
+        rep = obs.last_pipeline_report()
+        calls = rep["stage_calls"]
+        assert calls.get("hbm_hits") == 6
+        assert calls.get("bytes_hbm_hit") == calls.get("bytes_prepared")
+        assert calls.get("cache_misses") is None  # shard tier not hit
+
+    def test_dataset_epoch2_ships_zero(self, monkeypatch):
+        _clean_env(monkeypatch)
+        from tpudl.data import Dataset
+
+        f = _frame(n=64)
+        ds = Dataset(f, ["x"], batch_size=16, wire_codec="u8",
+                     device_cache=True)
+        for _ in ds.iter_epoch(0):
+            pass
+        shipped0 = _snap("data.wire.bytes_shipped")
+        hits0 = _snap("data.hbm.hits")
+        batches = [b for (b,) in ds.iter_epoch(1)]
+        assert _snap("data.wire.bytes_shipped") - shipped0 == 0
+        assert _snap("data.hbm.hits") - hits0 == ds.num_batches
+        # resident arrays restore to the same values the host path has
+        host = ds.device_restore((np.asarray(batches[0]),))[0]
+        assert host.dtype == np.float32
+
+    def test_trainer_fit_2_epochs_zero_wire(self, mesh8, monkeypatch):
+        """THE acceptance run: a 2-epoch fit over a Dataset with the
+        device cache armed — epoch 2 ships 0 bytes and every batch is
+        an HBM hit, asserted via the metrics registry; the fitted
+        params are bitwise equal to the cache-off fit."""
+        _clean_env(monkeypatch)
+        import optax
+
+        from tpudl.data import Dataset
+        from tpudl.train import Trainer
+
+        rng = np.random.default_rng(0)
+        n, d = 64, 4
+        f = Frame({"x": rng.integers(0, 256, (n, d)).astype(np.float32),
+                   "y": rng.normal(size=(n, 1)).astype(np.float32)})
+
+        def loss_fn(params, xb, yb):
+            return (((xb @ params["w"]) - yb) ** 2).mean()
+
+        def fit(device_cache):
+            dc.reset_device_cache()
+            ds = Dataset(f, ["x", "y"], batch_size=16,
+                         device_cache=device_cache, mesh=mesh8)
+            tr = Trainer(loss_fn, optax.sgd(1e-4), mesh=mesh8)
+            nb = ds.num_batches
+
+            def data_fn(step):
+                return ds.get_batch(step % nb)
+
+            p = {"w": np.zeros((d, 1), np.float32)}
+            # epoch 1 (populate), then measure epoch 2
+            p, opt, _ = tr.fit(p, data_fn, steps=nb)
+            shipped0 = _snap("data.wire.bytes_shipped")
+            hits0 = _snap("data.hbm.hits")
+            p, opt, _ = tr.fit(p, data_fn, steps=2 * nb, opt_state=opt)
+            return (np.asarray(p["w"]),
+                    _snap("data.wire.bytes_shipped") - shipped0,
+                    _snap("data.hbm.hits") - hits0, 2 * nb)
+
+        w_on, shipped, hits, steps = fit(True)
+        assert shipped == 0
+        assert hits == steps  # every step of the epoch-2 fit hit HBM
+        w_off, _, _, _ = fit(False)
+        np.testing.assert_array_equal(w_on, w_off)
+
+
+# ---------------------------------------------------------------------------
+# eviction, restart, topology (satellite)
+# ---------------------------------------------------------------------------
+
+class TestEvictionRestartTopology:
+    def test_tiny_budget_partial_residency_no_self_thrash(
+            self, monkeypatch):
+        """A budget holding ~2 of 6 batches: the run completes with
+        output parity, keeps its PREFIX resident (a scan never evicts
+        itself — no thrash, no evictions), and epoch 2 serves the
+        resident head from HBM while the tail transparently
+        re-transfers."""
+        _clean_env(monkeypatch)
+        f = _frame(n=48)  # 6 batches × 8 rows × 6 cols × 4 B = 192 B
+        # budget = 2.5 batches of 192 B
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB",
+                           str(2.5 * 192 / (1 << 20)))
+        dc.reset_device_cache()
+        jfn = _jfn()
+        ref_y = _ref(f, jfn)
+        ev0 = _snap("data.hbm.evictions")
+        kw = dict(batch_size=8, device_cache=True, autotune=False)
+        y1 = np.asarray(list(
+            f.map_batches(jfn, ["x"], ["y"], **kw)["y"]), np.float32)
+        np.testing.assert_array_equal(y1, ref_y)
+        assert _snap("data.hbm.evictions") - ev0 == 0  # no self-thrash
+        assert dc.get_device_cache().bytes_resident == 2 * 192
+        hits0 = _snap("data.hbm.hits")
+        y2 = np.asarray(list(
+            f.map_batches(jfn, ["x"], ["y"], **kw)["y"]), np.float32)
+        np.testing.assert_array_equal(y2, ref_y)
+        assert _snap("data.hbm.hits") - hits0 == 2  # the resident head
+
+    def test_cross_run_eviction_retransfers_transparently(
+            self, monkeypatch):
+        """A second dataset evicts the first's resident shards; the
+        first run's next epoch re-transfers the evicted batches with no
+        error and full parity."""
+        _clean_env(monkeypatch)
+        f1 = _frame(n=16, seed=7)   # 2 batches × 192 B
+        f2 = _frame(n=16, seed=11)  # different content → different key
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB",
+                           str(2.5 * 192 / (1 << 20)))
+        dc.reset_device_cache()
+        jfn = _jfn()
+        ref1 = _ref(f1, jfn)
+        kw = dict(batch_size=8, device_cache=True, autotune=False)
+        f1.map_batches(jfn, ["x"], ["y"], **kw)  # f1 resident
+        ev0 = _snap("data.hbm.evictions")
+        f2.map_batches(jfn, ["x"], ["y"], **kw)  # evicts f1's LRU
+        assert _snap("data.hbm.evictions") - ev0 > 0
+        y1 = np.asarray(list(
+            f1.map_batches(jfn, ["x"], ["y"], **kw)["y"]), np.float32)
+        np.testing.assert_array_equal(y1, ref1)  # transparent re-ship
+
+    def test_restart_cold_falls_back_to_shard_cache(self, tmp_path,
+                                                    monkeypatch):
+        """Cold device cache + warm disk shards = zero re-PREPARES and
+        exactly one re-SHIP; the next epoch is zero-wire again."""
+        _clean_env(monkeypatch)
+        f = _frame(n=32)
+        jfn = _jfn()
+        ref_y = _ref(f, jfn)
+        calls = {"n": 0}
+
+        def pack(sl):
+            calls["n"] += 1
+            return np.asarray(sl)
+
+        pack.thread_safe = True
+        pack.cache_token = "test-pack-v1"
+        kw = dict(batch_size=8, wire_codec="u8", device_cache=True,
+                  cache_dir=str(tmp_path), pack=pack, autotune=False)
+        f.map_batches(jfn, ["x"], ["y"], **kw)  # epoch 1: 4 packs
+        assert calls["n"] == 4
+        dc.reset_device_cache()  # the process restart
+        shipped0 = _snap("data.wire.bytes_shipped")
+        y = np.asarray(list(
+            f.map_batches(jfn, ["x"], ["y"], **kw)["y"]), np.float32)
+        np.testing.assert_array_equal(y, ref_y)
+        assert calls["n"] == 4  # shard tier: ZERO re-prepares
+        reshipped = _snap("data.wire.bytes_shipped") - shipped0
+        assert reshipped > 0  # bytes re-shipped exactly once...
+        shipped1 = _snap("data.wire.bytes_shipped")
+        f.map_batches(jfn, ["x"], ["y"], **kw)
+        assert _snap("data.wire.bytes_shipped") - shipped1 == 0  # ...once
+
+    def test_topology_mismatch_is_a_miss(self, mesh8, monkeypatch):
+        """Resident shards stored for the 8-way mesh are a key MISS on
+        a 4-way mesh (and single-chip): never replayed, never
+        resharded — the run re-prepares and stays correct."""
+        _clean_env(monkeypatch)
+        f = _frame(n=64, cols=8)
+        jfn = _jfn()
+        ref_y = _ref(f, jfn, batch_size=16)
+        kw = dict(batch_size=16, device_cache=True, autotune=False)
+        f.map_batches(jfn, ["x"], ["y"], mesh=mesh8, **kw)  # populate
+        hits0 = _snap("data.hbm.hits")
+        mesh4 = M.build_mesh(n_data=4)
+        y4 = np.asarray(list(f.map_batches(
+            jfn, ["x"], ["y"], mesh=mesh4, **kw)["y"]), np.float32)
+        np.testing.assert_array_equal(y4, ref_y)
+        assert _snap("data.hbm.hits") - hits0 == 0  # all misses
+        hits1 = _snap("data.hbm.hits")
+        ysingle = np.asarray(list(f.map_batches(
+            jfn, ["x"], ["y"], **kw)["y"]), np.float32)
+        np.testing.assert_array_equal(ysingle, ref_y)
+        assert _snap("data.hbm.hits") - hits1 == 0
+        # each topology now replays its OWN resident set
+        hits2 = _snap("data.hbm.hits")
+        f.map_batches(jfn, ["x"], ["y"], mesh=mesh8, **kw)
+        assert _snap("data.hbm.hits") - hits2 == 4
+
+
+# ---------------------------------------------------------------------------
+# donation × device-cache-hit contract (satellite)
+# ---------------------------------------------------------------------------
+
+class TestDonationContract:
+    def test_hit_after_donating_run_still_valid(self, monkeypatch):
+        """Three donating epochs over one resident set: if any donating
+        program had consumed a resident buffer, epoch 2/3 would replay
+        garbage (or crash on a deleted buffer). Bitwise parity every
+        epoch + a moving donation_blocked counter prove the non-
+        donating fallback is live."""
+        _clean_env(monkeypatch)
+        f = _frame()
+        jfn = _jfn()
+        ref_y = _ref(f, jfn)
+        blocked0 = _snap("data.hbm.donation_blocked")
+        kw = dict(batch_size=8, wire_codec="u8", device_cache=True,
+                  donate=True, dispatch_depth=4, autotune=False)
+        for epoch in range(3):
+            y = np.asarray(list(
+                f.map_batches(jfn, ["x"], ["y"], **kw)["y"]), np.float32)
+            np.testing.assert_array_equal(y, ref_y,
+                                          err_msg=f"epoch {epoch}")
+        # every resident batch of every epoch was routed away from the
+        # donating codec wrapper: populate (6) + 2 warm epochs (12)
+        assert _snap("data.hbm.donation_blocked") - blocked0 == 18
+
+    def test_donate_off_counts_nothing(self, monkeypatch):
+        _clean_env(monkeypatch)
+        f = _frame()
+        blocked0 = _snap("data.hbm.donation_blocked")
+        kw = dict(batch_size=8, wire_codec="u8", device_cache=True,
+                  donate=False, autotune=False)
+        for _ in range(2):
+            f.map_batches(_jfn(), ["x"], ["y"], **kw)
+        assert _snap("data.hbm.donation_blocked") - blocked0 == 0
+
+
+# ---------------------------------------------------------------------------
+# estimator bulk residency (the multi-epoch fitting shape)
+# ---------------------------------------------------------------------------
+
+class TestEstimatorBulkResidency:
+    def test_multi_epoch_fit_rides_bulk_residency(self, tmp_path,
+                                                  monkeypatch):
+        """KerasImageFileEstimator(deviceCache=True): the loaded X/y
+        place on device once (data.hbm.puts), a re-fit over the same
+        data re-hits the resident bulk (data.hbm.hits), and the
+        trained transformer scores identically to the cache-off fit —
+        bitwise, same compiled step, same values."""
+        _clean_env(monkeypatch)
+        keras = pytest.importorskip("keras")
+        from tpudl.ml import KerasImageFileEstimator
+
+        keras.utils.set_random_seed(0)
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(2, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        model_path = str(tmp_path / "tiny.keras")
+        m.save(model_path)
+        rng = np.random.default_rng(0)
+        imgs = {f"u{i}": rng.integers(0, 256, (8, 8, 3), np.uint8)
+                for i in range(12)}
+
+        def loader(uri):
+            return (imgs[uri].astype(np.float32) / 255.0)
+
+        loader.cache_token = "dc-test-loader"
+        frame = Frame({
+            "uri": np.array(list(imgs), dtype=object),
+            "label": np.stack([np.eye(2, dtype=np.float32)[i % 2]
+                               for i in range(12)])})
+
+        def fit(device_cache):
+            dc.reset_device_cache()
+            est = KerasImageFileEstimator(
+                inputCol="uri", outputCol="out", labelCol="label",
+                imageLoader=loader, modelFile=model_path,
+                kerasOptimizer="adam",
+                kerasLoss="categorical_crossentropy",
+                kerasFitParams={"batch_size": 4, "epochs": 3,
+                                "seed": 0},
+                deviceCache=device_cache)
+            return est, est.fit(frame)
+
+        puts0 = _snap("data.hbm.puts")
+        est_on, model_on = fit(True)
+        assert _snap("data.hbm.puts") - puts0 >= 1  # bulk placed once
+        hits0 = _snap("data.hbm.hits")
+        est_on.fit(frame)  # re-fit: the resident bulk re-hits
+        assert _snap("data.hbm.hits") - hits0 >= 1
+        _, model_off = fit(False)
+        out_on = model_on.transform(frame)
+        out_off = model_off.transform(frame)
+        np.testing.assert_array_equal(
+            np.stack(list(out_on["out"])),
+            np.stack(list(out_off["out"])))
+
+
+# ---------------------------------------------------------------------------
+# public ml surface: repeat-transform rides the HBM edge
+# ---------------------------------------------------------------------------
+
+class TestPredictorRepeatTransform:
+    def test_deep_image_predictor_repeat_transform_hits_hbm(
+            self, monkeypatch):
+        """The paper's repeat-batch-inference shape through the PUBLIC
+        API: DeepImagePredictor(deviceCache=True) over the same frame
+        twice — the second transform serves every batch from HBM with
+        zero wire bytes, scores identical."""
+        _clean_env(monkeypatch)
+        from tpudl.image import imageIO
+        from tpudl.ml import DeepImagePredictor
+
+        rng = np.random.default_rng(3)
+        structs = [imageIO.imageArrayToStruct(
+            rng.integers(0, 256, size=(32, 32, 3), dtype=np.uint8))
+            for _ in range(16)]
+        frame = Frame({"image": structs})
+        pred = DeepImagePredictor(inputCol="image", outputCol="p",
+                                  modelName="ResNet50", batchSize=8,
+                                  deviceCache=True)
+        out1 = pred.transform(frame)
+        hits0 = _snap("data.hbm.hits")
+        shipped0 = _snap("data.wire.bytes_shipped")
+        out2 = pred.transform(frame)
+        assert _snap("data.hbm.hits") - hits0 == 2  # both batches
+        assert _snap("data.wire.bytes_shipped") - shipped0 == 0
+        np.testing.assert_array_equal(
+            np.stack(list(out1["p"])), np.stack(list(out2["p"])))
+
+
+# ---------------------------------------------------------------------------
+# roofline: wire subtraction + device_cache advice (satellite)
+# ---------------------------------------------------------------------------
+
+class TestRooflineResidency:
+    def _report(self, hbm_frac: float, **over):
+        bp = 100 << 20
+        rep = {
+            "run_id": "fixture", "rows": 1000, "rows_done": 1000,
+            "wall_seconds": 10.0,
+            "stage_seconds": {"dispatch": 9.5, "infeed_wait": 0.1},
+            "stage_calls": {"dispatch": 10, "bytes_prepared": bp,
+                            "bytes_hbm_hit": int(bp * hbm_frac)},
+            "fuse_steps": 1, "dispatch_depth": 1, "prefetch_depth": 2,
+            "prepare_workers": 2, "batch_size": 100,
+            "wire_codec": "u8", "device_cache": hbm_frac > 0,
+        }
+        rep.update(over)
+        return rep
+
+    def test_90pct_resident_run_is_not_wire_bound(self):
+        """The double-counting fix: 90% of the dispatch-fed bytes never
+        crossed the link, so the wire model may claim only the
+        remaining 10% — the phantom wire bottleneck disappears."""
+        from tpudl.obs import roofline
+
+        cold = roofline.analyze(self._report(0.0), h2d_mbps=10.0,
+                                device_ms_per_dispatch=50.0,
+                                publish=False)
+        warm = roofline.analyze(self._report(0.9), h2d_mbps=10.0,
+                                device_ms_per_dispatch=50.0,
+                                publish=False)
+        assert cold.bottleneck == "wire_h2d"  # 10s of modeled wire
+        assert warm.bottleneck != "wire_h2d"
+        assert warm.wire_h2d_s == pytest.approx(1.0, rel=0.01)
+        assert warm.inputs["bytes_hbm_hit"] == int(0.9 * (100 << 20))
+
+    def test_advisor_recommends_device_cache_when_fitting(self,
+                                                         monkeypatch):
+        from tpudl.obs import roofline
+
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB", "256")
+        rr = roofline.analyze(self._report(0.0), h2d_mbps=10.0,
+                              device_ms_per_dispatch=50.0,
+                              publish=False)
+        recs = {r["knob"]: r for r in rr.advice}
+        assert "device_cache" in recs
+        assert recs["device_cache"]["recommended"] == "on"
+        assert recs["device_cache"]["predicted_gain_pct"] > 0
+
+    def test_advisor_silent_when_over_budget_or_armed(self,
+                                                      monkeypatch):
+        from tpudl.obs import roofline
+
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB", "16")  # < 100MB
+        rr = roofline.analyze(self._report(0.0), h2d_mbps=10.0,
+                              device_ms_per_dispatch=50.0,
+                              publish=False)
+        assert "device_cache" not in {r["knob"] for r in rr.advice}
+        monkeypatch.setenv("TPUDL_DATA_HBM_BUDGET_MB", "256")
+        rr = roofline.analyze(self._report(0.9), h2d_mbps=10.0,
+                              device_ms_per_dispatch=50.0,
+                              publish=False)
+        assert "device_cache" not in {r["knob"] for r in rr.advice}
+
+
+# ---------------------------------------------------------------------------
+# live status plane (satellite)
+# ---------------------------------------------------------------------------
+
+def _load_validate_status():
+    spec = importlib.util.spec_from_file_location(
+        "validate_status",
+        os.path.join(REPO, "tools", "validate_status.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestLiveStatusHBM:
+    def test_status_carries_hbm_and_render_shows_it(self, monkeypatch):
+        _clean_env(monkeypatch)
+        from tpudl.obs import live
+
+        f = _frame()
+        for _ in range(2):  # populate + warm (hits move)
+            f.map_batches(_jfn(), ["x"], ["y"], batch_size=8,
+                          device_cache=True, autotune=False)
+        payload = live.collect_status()
+        hbm = payload.get("hbm")
+        assert hbm is not None
+        assert hbm["bytes_resident"] > 0
+        assert hbm["hits"] >= 6
+        assert hbm["budget_bytes"] and 0 <= hbm["budget_pct"] <= 100
+        frame_txt = live.render([payload])
+        assert "hbm:" in frame_txt
+        assert "resident" in frame_txt
+        # hits/s appears once a prior tick exists
+        payload2 = live.collect_status()
+        assert payload2["hbm"]["hits_per_s"] is not None
+        # the validator accepts the extended payload
+        vs = _load_validate_status()
+        assert vs.validate_payload(payload) == []
+
+    def test_status_without_cache_has_no_hbm_line(self, monkeypatch):
+        _clean_env(monkeypatch)
+        from tpudl.obs import live
+
+        # a fresh process never arming the cache publishes no
+        # bytes_resident gauge — but THIS process likely has; simulate
+        # by filtering the metrics the section reads
+        assert live._hbm_section({}, 0.0) is None

@@ -144,6 +144,17 @@ METRICS = [
            keys=[("async_dispatch", "dispatch_overlap_pct")],
            tail_patterns=[r'"dispatch_overlap_pct": ' + _NUM],
            wire_sensitive=False, floor=0.30),
+    # device-cache: a within-round ratio (epoch-2 HBM-resident over
+    # epoch-1 cold, same program/rows) — scored raw like async_speedup.
+    # A drop is residency regressing (hits falling back to the wire:
+    # key churn, budget mis-accounting, donation fallback copies) — an
+    # executor/cache regression, never weather. (hbm_epoch2_bytes_
+    # shipped also rides the judged line as the hard zero-wire claim
+    # but is an exact-0 contract, not a banded rate.)
+    Metric("hbm_warm_speedup",
+           keys=[("device_cache", "hbm_warm_speedup")],
+           tail_patterns=[r'"hbm_warm_speedup": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
     # mesh-scaling: a within-round ratio (sharded executor over the
     # single-chip fast path on the virtual 8-device CPU mesh, same
     # program/rows) — no wire, no tunnel; scored raw like

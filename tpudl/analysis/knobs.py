@@ -83,6 +83,12 @@ KNOBS: tuple[Knob, ...] = (
          "prepared-batch shard cache directory (unset = cache off)"),
     Knob("TPUDL_DATA_VERIFY", "enum", "first", "data",
          "shard checksum policy: first|always|never"),
+    Knob("TPUDL_DATA_DEVICE_CACHE", "bool", "0", "data",
+         "1 arms HBM-tier batch residency: prepared encoded batches "
+         "pin in device memory, epochs >= 2 ship zero wire bytes"),
+    Knob("TPUDL_DATA_HBM_BUDGET_MB", "float", "", "data",
+         "device-cache resident-byte budget in MB (unset = a "
+         "conservative fraction of reported device memory)"),
     # -- observability (OBSERVABILITY.md) ------------------------------
     Knob("TPUDL_METRICS_FILE", "path", "", "obs",
          "JSONL metrics sink path (unset = no sink)"),
@@ -198,6 +204,9 @@ KNOBS: tuple[Knob, ...] = (
          "decode sub-bench image count"),
     Knob("TPUDL_BENCH_DATA_N", "int", "512", "bench",
          "data-pipeline sub-bench row count"),
+    Knob("TPUDL_BENCH_HBM_N", "int", "512", "bench",
+         "device-cache sub-bench row count (epoch-1 cold vs epoch-2 "
+         "resident)"),
     Knob("TPUDL_BENCH_DATA_FILES", "int", "192", "bench",
          "data-pipeline cache sub-bench file count"),
     Knob("TPUDL_BENCH_ASYNC_N", "int", "768", "bench",

@@ -79,6 +79,10 @@ LOCKS: tuple[LockDecl, ...] = (
              "status-writer singleton start/stop"),
     LockDecl("obs.watchdog.daemon", "tpudl.obs.watchdog", "lock",
              "module", 16, "watchdog daemon singleton start/stop"),
+    LockDecl("data.device_cache.singleton", "tpudl.data.device_cache",
+             "lock", "module", 16,
+             "process-wide DeviceBatchCache create/reset (construction "
+             "publishes the budget gauges — metrics locks are higher)"),
     # -- rank 18 -------------------------------------------------------
     LockDecl("data.codec.plan", "tpudl.data.codec", "lock", "instance",
              18, "CodecPlan per-column codec resolution/adoption"),
@@ -97,6 +101,10 @@ LOCKS: tuple[LockDecl, ...] = (
              "instance", 20, "LazyFileColumn small-access decode memo"),
     LockDecl("obs.pipeline.ring", "tpudl.obs.pipeline", "lock",
              "module", 20, "bounded ring of recent PipelineReports"),
+    LockDecl("data.device_cache", "tpudl.data.device_cache", "lock",
+             "instance", 20,
+             "DeviceBatchCache entry map + LRU order + resident-byte "
+             "and pin accounting (metrics published outside the lock)"),
     # -- rank 24: the two registries (their armed lockset checks file
     #    breadcrumbs into the flight recorder (25); they never nest
     #    with each other) ---------------------------------------------
@@ -135,6 +143,10 @@ LOCKS: tuple[LockDecl, ...] = (
              30, "host-span tracer ring + dropped counter"),
     LockDecl("image.lazyfile.reads", "tpudl.image.imageIO", "lock",
              "instance", 30, "LazyFileColumn read counter"),
+    LockDecl("data.device_cache.token_memo", "tpudl.data.device_cache",
+             "lock", "module", 30,
+             "array_token memo map (concurrent estimator trial "
+             "threads share it; pure dict ops under the lock)"),
 )
 
 LOCK_NAMES = frozenset(d.name for d in LOCKS)

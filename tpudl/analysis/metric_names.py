@@ -94,6 +94,25 @@ METRICS: tuple[Metric, ...] = (
            "shards unlinked by eviction mid-read (treated as a miss)"),
     Metric("data.cache.bytes_read", "counter", "shard bytes read"),
     Metric("data.cache.bytes_written", "counter", "shard bytes written"),
+    # -- data: HBM-tier device cache (DATA.md "Cache hierarchy") -------
+    Metric("data.hbm.bytes_resident", "gauge",
+           "bytes currently pinned in the device batch cache"),
+    Metric("data.hbm.budget_bytes", "gauge",
+           "device-cache resident-byte budget "
+           "(TPUDL_DATA_HBM_BUDGET_MB or derived)"),
+    Metric("data.hbm.hits", "counter",
+           "batches served device-resident (zero wire bytes)"),
+    Metric("data.hbm.misses", "counter",
+           "device-cache lookups that fell through to the lower tiers"),
+    Metric("data.hbm.puts", "counter", "batches made resident"),
+    Metric("data.hbm.evictions", "counter",
+           "LRU entries evicted to fit the budget"),
+    Metric("data.hbm.bytes_served", "counter",
+           "bytes served from HBM instead of the wire (the roofline "
+           "subtracts these from its wire attribution)"),
+    Metric("data.hbm.donation_blocked", "counter",
+           "resident batches routed away from a donating program "
+           "(resident buffers are never donated)"),
     # -- image IO ------------------------------------------------------
     Metric("imageio.files_read", "counter", "files read off disk"),
     Metric("imageio.bytes_read", "counter", "bytes read off disk"),

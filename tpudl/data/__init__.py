@@ -15,7 +15,12 @@ operator guide), three pillars:
 - :mod:`tpudl.data.dataset` — **Dataset facade**: epoch iteration with
   replay, plus :func:`cached_uri_load` (the estimator's bulk-load
   cache). ``Frame.map_batches(wire_codec=..., cache_dir=...)`` plumbs
-  the same machinery under every ml transformer and SQL UDF.
+  the same machinery under every ml transformer and SQL UDF;
+- :mod:`tpudl.data.device_cache` — **HBM-tier residency**: prepared,
+  codec-encoded batches pinned in device memory under an explicit
+  budget (``TPUDL_DATA_HBM_BUDGET_MB``), LRU-evicted, topology-keyed —
+  epochs ≥ 2 of a fitting run ship ZERO wire bytes (DATA.md "Cache
+  hierarchy").
 """
 
 from __future__ import annotations
@@ -25,6 +30,8 @@ from tpudl.data.codec import (BF16Codec, CodecError, CodecPlan,
                               codec_from_key, probe_wire_mbps,
                               resolve_codec)
 from tpudl.data.dataset import Dataset, cached_uri_load
+from tpudl.data.device_cache import (DeviceBatchCache, get_device_cache,
+                                     reset_device_cache)
 from tpudl.data.shards import ShardCache, ShardCorruption, cache_key
 
 __all__ = [
@@ -33,6 +40,8 @@ __all__ = [
     "CodecPlan", "resolve_codec", "codec_from_key", "probe_wire_mbps",
     # shard cache
     "ShardCache", "ShardCorruption", "cache_key",
+    # device cache (HBM tier)
+    "DeviceBatchCache", "get_device_cache", "reset_device_cache",
     # facade
     "Dataset", "cached_uri_load",
 ]

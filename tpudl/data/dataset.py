@@ -126,7 +126,8 @@ class Dataset:
     def __init__(self, frame, input_cols, *, batch_size: int = 256,
                  wire_codec=None, cache_dir: str | None = None,
                  pack=None, cache_key_material: str | None = None,
-                 retain: bool = False):
+                 retain: bool = False, device_cache: bool = False,
+                 mesh=None):
         from tpudl.data import codec as _codec
 
         self._frame = frame
@@ -142,8 +143,18 @@ class Dataset:
         self._resolving = False  # wrap()'s probe: no wire accounting
         self._memory: dict[int, tuple] = {}
         self._cache = None
-        if cache_dir is not None:
-            from tpudl.data.shards import ShardCache, cache_key
+        self._mesh = mesh
+        self._dcache = self._dkey = None
+        # EXPLICIT opt-in only — deliberately NOT the
+        # TPUDL_DATA_DEVICE_CACHE env knob: armed, get_batch returns
+        # device jax.Arrays, and a Dataset's consumers are arbitrary
+        # host code (jobs loops, tests) whose numpy contract a
+        # process-wide env flip must never change. Frame.map_batches
+        # honors the env because it guards on device fns itself.
+        dc_flag = bool(device_cache)
+        need_key = cache_dir is not None or dc_flag
+        if need_key:
+            from tpudl.data.shards import cache_key
 
             material = (cache_key_material
                         if cache_key_material is not None
@@ -152,9 +163,23 @@ class Dataset:
                             batch=self._batch,
                             codec=_codec.spec_token(wire_codec),
                             layout="dataset_v1")
+        if cache_dir is not None:
+            from tpudl.data.shards import ShardCache
+
             self._cache = ShardCache(cache_dir, key)
             if self._plan is not None and self._cache.meta.get("codecs"):
                 self._plan.adopt(self._cache.meta["codecs"])
+        if dc_flag:
+            # the HBM tier above the shard cache (DATA.md "Cache
+            # hierarchy"): epoch 1 populates (batches become resident
+            # as they first ship), epochs ≥ 2 stream from device
+            # memory — zero wire bytes, zero decodes. Keys carry the
+            # mesh topology: a Dataset feeding a sharded Trainer never
+            # replays another mesh's shards.
+            from tpudl.data import device_cache as _dc
+
+            self._dkey = _dc.run_key(key, mesh)
+            self._dcache = _dc.get_device_cache()
 
     # -- shape -------------------------------------------------------------
     def __len__(self) -> int:
@@ -189,8 +214,26 @@ class Dataset:
         return tuple(arrays)
 
     def get_batch(self, index: int) -> tuple:
-        """One prepared (encoded) batch by index: cache → memory →
-        prepare (+persist)."""
+        """One prepared (encoded) batch by index: device cache (HBM,
+        zero wire bytes) → shard cache → memory → prepare (+persist +
+        make-resident)."""
+        if self._dcache is not None:
+            pin = self._dcache.get((self._dkey, index))
+            if pin is not None and (self._plan is None
+                                    or self._plan.resolved()
+                                    or pin.codecs):
+                if self._plan is not None and not self._plan.resolved():
+                    self._plan.adopt(pin.codecs)
+                # resident replay: the bytes never cross the wire, so
+                # record_shipped is deliberately NOT called (the
+                # zero-wire-warm-epoch acceptance reads that counter);
+                # the pin releases immediately — the consumer's own
+                # reference keeps the buffers alive, the cache only
+                # needs the LRU touch and the served-bytes accounting
+                pin.release()
+                return pin.arrays
+            if pin is not None:
+                pin.release()  # unusable hit (codec resolution lost)
         if self._cache is not None:
             hit = self._cache.get(index)
             # an all-hits replay still needs resolved codecs for the
@@ -200,12 +243,12 @@ class Dataset:
                                     or self._plan.resolved()):
                 if self._plan is not None and not self._resolving:
                     self._plan.record_shipped(hit)
-                return tuple(hit)
+                return self._make_resident(index, tuple(hit))
         elif index in self._memory:
             batch = self._memory[index]
             if self._plan is not None and not self._resolving:
                 self._plan.record_shipped(batch)
-            return batch
+            return self._make_resident(index, batch)
         batch = self._prepare(index)
         if self._plan is not None and not self._resolving:
             self._plan.record_shipped(batch)
@@ -216,7 +259,44 @@ class Dataset:
                 self._cache.set_meta({"codecs": self._plan.keys()})
         elif self._retain:
             self._memory[index] = batch
-        return batch
+        return self._make_resident(index, batch)
+
+    def _make_resident(self, index: int, batch: tuple) -> tuple:
+        """Populate the HBM tier with one prepared batch (epoch-1 path:
+        the bytes cross the wire exactly once, via this placement) and
+        return the RESIDENT arrays so the consumer's step feeds on
+        device buffers directly. Falls back to the host batch when the
+        device cache is off, the budget is exhausted, or (mesh) the
+        ragged tail doesn't shard evenly. The wrap() resolution probe
+        (``_resolving``) never places — a probe must not allocate
+        HBM."""
+        if self._dcache is None or self._resolving:
+            return batch
+        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in batch)
+        if not self._dcache.would_fit(nbytes, run=self._dkey):
+            return batch
+        if self._mesh is not None:
+            from tpudl import mesh as M
+
+            mult = self._mesh.shape[M.DATA_AXIS]
+            if batch and int(np.shape(batch[0])[0]) % mult != 0:
+                return batch  # ragged tail: plain per-epoch transfer
+            placed = tuple(M.transfer_batch(list(batch), self._mesh))
+        else:
+            import jax
+
+            placed = tuple(jax.device_put(list(batch)))
+        codecs = (self._plan.keys()
+                  if self._plan is not None and self._plan.resolved()
+                  else None)
+        pin = self._dcache.put((self._dkey, index), placed,
+                               codecs=codecs)
+        if pin is not None:
+            # the consumer's own reference keeps this batch's buffers
+            # alive through its step; the cache pin is only eviction
+            # accounting, released as soon as the entry is filed
+            pin.release()
+        return placed
 
     def iter_epoch(self, epoch: int = 0):
         """Yield every prepared batch in order. ``epoch`` only labels

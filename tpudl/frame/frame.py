@@ -587,6 +587,7 @@ class Frame:
         wire_codec=None,
         cache_dir: str | None = None,
         cache_key: str | None = None,
+        device_cache: bool | None = None,
     ) -> "Frame":
         """Run ``fn`` over the frame in device-sized batches; append outputs.
 
@@ -680,6 +681,21 @@ class Frame:
           runs and epochs ≥ 2 over the same inputs skip decode/pack
           entirely. ``cache_key`` overrides the fingerprint for frames
           whose columns cannot self-identify (raises otherwise).
+        - ``device_cache`` (env ``TPUDL_DATA_DEVICE_CACHE``): pin the
+          prepared, wire-ENCODED batches in device memory (HBM) under
+          the ``TPUDL_DATA_HBM_BUDGET_MB`` budget — the top tier of the
+          cache hierarchy (DATA.md "Cache hierarchy"). A hit bypasses
+          prepare, codec encode and the H2D transfer entirely and feeds
+          the dispatch window a resident buffer; epochs ≥ 2 of a
+          fitting run ship ZERO wire bytes. Entries are keyed by the
+          same fingerprint identity as the shard cache plus the mesh
+          topology (a shard resident under one ``NamedSharding`` is a
+          key miss on any other mesh). Resident buffers are never
+          donated (hits route through the non-donating program —
+          ``data.hbm.donation_blocked`` counts the fallback), and
+          residency forces ``fuse_steps`` to 1: fusion amortizes the
+          per-dispatch round-trip by re-stacking HOST batches, which
+          would defeat the residency it rides with. Device fns only.
         """
         if batch_size is None:
             if self.num_partitions:
@@ -826,8 +842,18 @@ class Frame:
             wire_codec = os.environ.get("TPUDL_WIRE_CODEC") or None
         if cache_dir is None:
             cache_dir = os.environ.get("TPUDL_DATA_CACHE_DIR") or None
+        dc_flag = (bool(device_cache) if device_cache is not None
+                   else os.environ.get("TPUDL_DATA_DEVICE_CACHE", "0")
+                   == "1")
+        # the HBM tier needs a REAL device fn (resident jax arrays
+        # would break a host fn's numpy contract) and the fast path
+        # armed; the serial kill switch and the conservative mesh arm
+        # stay residency-free (their A/B role is the un-cached wire)
+        dc_flag = (dc_flag and device_fn_real and not killed
+                   and not mesh_slow)
         plan = cache = None
-        if wire_codec is not None or cache_dir is not None:
+        dcache = dkey = None
+        if wire_codec is not None or cache_dir is not None or dc_flag:
             from tpudl.data import codec as _codec
 
             if wire_codec is not None and not device_flag:
@@ -839,12 +865,29 @@ class Frame:
             if wire_codec is not None:
                 plan = _codec.CodecPlan(wire_codec, len(input_cols),
                                         report=report)
-            if cache_dir is not None:
+            material = None
+            pack_token = None
+            if cache_dir is not None or dc_flag:
                 from tpudl.data import shards as _shards
 
                 material = cache_key
                 if material is None:
-                    material = self.fingerprint(input_cols)
+                    try:
+                        material = self.fingerprint(input_cols)
+                    except ValueError:
+                        # a lazy column with no content fingerprint:
+                        # EXPLICITLY-requested caching (cache_dir, or
+                        # device_cache=True as a kwarg) keeps the
+                        # clear pass-cache_key error — but the
+                        # process-wide TPUDL_DATA_DEVICE_CACHE=1
+                        # accelerator must never turn a working
+                        # uncached run into a crash; residency just
+                        # disarms (plain wire transfer, the device
+                        # cache's degrade-never-error contract)
+                        if cache_dir is not None or device_cache:
+                            raise
+                        dc_flag = False
+            if cache_dir is not None or dc_flag:
                 # the pack is part of the prepared bytes' identity: a
                 # different pack (e.g. a loader with another geometry)
                 # must re-key, not replay. A pack without an explicit
@@ -857,22 +900,45 @@ class Frame:
                 pack_token = ("default" if pack is None else
                               getattr(pack, "cache_token", None)
                               or repr(pack))
-                cache = _shards.ShardCache(
-                    cache_dir,
-                    _shards.cache_key(material,
-                                      cols=",".join(input_cols),
-                                      batch=int(batch_size),
-                                      codec=_codec.spec_token(wire_codec),
-                                      pack=pack_token,
-                                      # the sanitizer runs on the MISS
-                                      # path only; a run asking for it
-                                      # must not warm-skip the check
-                                      finite=bool(check_finite),
-                                      layout="map_batches_v1"))
+                key_str = _shards.cache_key(
+                    material,
+                    cols=",".join(input_cols),
+                    batch=int(batch_size),
+                    codec=_codec.spec_token(wire_codec),
+                    pack=pack_token,
+                    # the sanitizer runs on the MISS
+                    # path only; a run asking for it
+                    # must not warm-skip the check
+                    finite=bool(check_finite),
+                    layout="map_batches_v1")
+            if cache_dir is not None:
+                cache = _shards.ShardCache(cache_dir, key_str)
                 if plan is not None and cache.meta.get("codecs"):
                     # warm replay MUST restore with the codecs the
                     # shards were encoded with, not a fresh auto pick
                     plan.adopt(cache.meta["codecs"])
+            if dc_flag:
+                from tpudl.data import device_cache as _dc
+
+                # SAME key material as the shard cache + the mesh
+                # topology: a resident shard sharded for one mesh is a
+                # key MISS on any other (never resharded in place)
+                dkey = _dc.run_key(key_str, mesh)
+                dcache = _dc.get_device_cache()
+                if fuse > 1:
+                    # residency replaces fusion: the fused program
+                    # re-stacks HOST microbatches (np.stack), which
+                    # would force resident buffers back through the
+                    # wire — and under a mesh, fuse==1 is what routes
+                    # the sharded transfer through prepare where the
+                    # populated buffers are born. Round-trips stay
+                    # hidden by the dispatch window.
+                    fuse = 1
+                    if "fuse_steps" in seeded:
+                        # an autotune seed residency disarms must not
+                        # be REPORTED as applied (the `autotuned`
+                        # contract — same rule as the mesh gate)
+                        seeded.remove("fuse_steps")
 
         report.config = {
             "executor": ("pipelined" if (prefetch or fuse > 1
@@ -896,6 +962,7 @@ class Frame:
             "wire_codec": (plan.names()[0] if plan is not None
                            else "off"),
             "batch_cache": bool(cache is not None),
+            "device_cache": bool(dcache is not None),
         }
         obs.set_last_pipeline(report)
 
@@ -919,13 +986,49 @@ class Frame:
             pack/decode/encode path by a memory-mapped read; a miss (or
             a corrupt shard) prepares as usual and persists the result.
             Wire encoding happens AFTER pack and the finite check (the
-            check must see restored float values, not wire bytes)."""
+            check must see restored float values, not wire bytes).
+
+            With a DEVICE cache (DATA.md "Cache hierarchy"), an HBM hit
+            short-circuits everything above — no pack, no decode, no
+            encode, no transfer: the resident (already encoded, already
+            sharded) buffers feed the dispatch window directly, pinned
+            until their dispatch returns. Returns
+            ``(arrays, n_pad, pin-or-None)`` — a non-None pin marks the
+            batch RESIDENT (the consumer routes it through the
+            non-donating program and releases the pin after
+            dispatch)."""
             with report.stage("prepare"):
                 bidx = start // batch_size
                 # executor-stage fault points (tpudl.testing.faults):
                 # the robustness suite raises/kills inside an exact
                 # stage at an exact batch; unarmed this is a None-check
                 _faults.fire("frame.prepare", index=bidx)
+                if dcache is not None:
+                    pin = dcache.get((dkey, bidx))
+                    # an all-hits replay still needs resolved codecs
+                    # for the device prologue (same guard as the shard
+                    # cache below) — entries persist their codec keys
+                    if pin is not None and (
+                            plan is None or plan.resolved()
+                            or pin.codecs):
+                        if plan is not None and not plan.resolved():
+                            plan.adopt(pin.codecs)
+                        pins.add(pin)
+                        # `bytes_prepared` keeps meaning "bytes fed to
+                        # dispatch"; `bytes_hbm_hit` is the resident
+                        # share the roofline subtracts from its wire
+                        # model (these bytes never crossed the link,
+                        # and data.wire.bytes_shipped stays untouched)
+                        report.count("bytes_prepared", pin.nbytes)
+                        report.count("bytes_hbm_hit", pin.nbytes)
+                        report.count("hbm_hits")
+                        _flight.record_batch(
+                            "prepare", bidx, pin.arrays,
+                            rows=stop - start, cache_hit=True,
+                            hbm_hit=True, run=report.run_id)
+                        return list(pin.arrays), pin.n_pad, pin
+                    if pin is not None:
+                        pin.release()  # unusable hit: codecs unknown
                 packed = None
                 cache_hit = False
                 if cache is not None:
@@ -1038,6 +1141,32 @@ class Frame:
                                 # new async transfer edge instead of
                                 # silently exercising it too
                                 jax.block_until_ready(packed)
+                if dcache is not None:
+                    # populate the HBM tier: the batch becomes resident
+                    # NOW and the resident buffers themselves feed this
+                    # dispatch — the bytes cross the wire exactly once.
+                    # Mesh path (fuse==1 → transfer_in_prepare): packed
+                    # is already the sharded device tree; single-chip:
+                    # one batched async device_put, budget-gated so an
+                    # over-budget batch never ships a doomed copy.
+                    codecs = (plan.keys()
+                              if plan is not None and plan.resolved()
+                              else None)
+                    pin = None
+                    if mesh is not None:
+                        pin = dcache.put((dkey, bidx), packed,
+                                         n_pad=n_pad, codecs=codecs)
+                    elif dcache.would_fit(
+                            sum(int(getattr(a, "nbytes", 0))
+                                for a in packed), run=dkey):
+                        import jax
+
+                        packed = jax.device_put(list(packed))
+                        pin = dcache.put((dkey, bidx), packed,
+                                         n_pad=n_pad, codecs=codecs)
+                    if pin is not None:
+                        pins.add(pin)
+                        return list(pin.arrays), n_pad, pin
                 # mesh=None: host arrays go straight into the jitted fn even
                 # when prefetching — the runtime's own arg transfer pipelines
                 # far better than an explicit device_put on tunneled/remote
@@ -1046,7 +1175,20 @@ class Frame:
                 # prefetch win here is the pack/decode work riding under
                 # compute; the transfer stays on the dispatch path (so
                 # ``h2d`` shows up inside ``dispatch`` on this path).
-                return packed, n_pad
+                return packed, n_pad, None
+
+        # device-cache pin tokens currently OUTSTANDING (hits +
+        # populates awaiting their dispatch): the dispatch path
+        # releases AND discards each token, so the set — and, through
+        # Pin._entry, the device buffers of entries another run may
+        # have evicted meanwhile — stays bounded by the in-flight
+        # window, not the whole run. The outer-finally sweep catches
+        # only tokens an unwind stranded (cancelled window futures);
+        # release is idempotent per token, so the double call is safe.
+        # set add/discard are single GIL-atomic ops (prepare-pool and
+        # dispatch threads touch it concurrently); the sweep iterates
+        # a snapshot.
+        pins: set = set()
 
         outputs: list[list[np.ndarray]] = [[] for _ in output_cols]
         acc: list[list] = [[] for _ in output_cols]  # device-resident results
@@ -1156,36 +1298,46 @@ class Frame:
         window = (_DispatchWindow(d_depth, report) if d_depth > 1
                   else None)
 
-        def dispatch(call_fn, args, idx, n_pad, fused=False):
+        def dispatch(call_fn, args, idx, n_pad, fused=False, pin=None):
             """Issue one dispatch: directly on the consumer (serial /
             depth 1) or onto the in-flight window. The dispatch stage
             itself — fault point, fn call, and starting the outputs'
             device→host copies — runs on whichever thread executes it;
-            results are handled strictly in issue order."""
+            results are handled strictly in issue order. ``pin`` is the
+            batch's device-cache pin token, released once the dispatch
+            has consumed the resident buffers (eviction accounting must
+            not drop bytes still feeding an in-flight program)."""
             def run():
-                call_args = args
-                if mesh is not None and call_args \
-                        and isinstance(call_args[0], np.ndarray):
-                    # mesh batches still host-side (fused groups, the
-                    # ragged tail of a fused run, shape-drift
-                    # fallbacks): ONE batched async transfer under the
-                    # group's NamedSharding — P(None, data, ...) for a
-                    # stacked (M, B, ...) group, P(data, ...) per batch
-                    # — on the dispatching thread, so the copy rides
-                    # inside the window like every other round-trip
-                    with report.stage("h2d"):
-                        call_args = M.transfer_batch(
-                            list(call_args), mesh,
-                            batch_dim=1 if fused else 0)
-                with report.stage("dispatch"):
-                    _faults.fire("frame.dispatch", index=idx)
-                    result = call_fn(*call_args)
-                if not isinstance(result, (tuple, list)):
-                    result = (result,)
-                # D2H starts NOW, at dispatch, for both outfeed modes —
-                # batch idx's copy overlaps the next dispatches
-                _start_host_copies(result)
-                return result, n_pad
+                try:
+                    call_args = args
+                    if mesh is not None and call_args \
+                            and isinstance(call_args[0], np.ndarray):
+                        # mesh batches still host-side (fused groups,
+                        # the ragged tail of a fused run, shape-drift
+                        # fallbacks): ONE batched async transfer under
+                        # the group's NamedSharding — P(None, data,
+                        # ...) for a stacked (M, B, ...) group,
+                        # P(data, ...) per batch — on the dispatching
+                        # thread, so the copy rides inside the window
+                        # like every other round-trip
+                        with report.stage("h2d"):
+                            call_args = M.transfer_batch(
+                                list(call_args), mesh,
+                                batch_dim=1 if fused else 0)
+                    with report.stage("dispatch"):
+                        _faults.fire("frame.dispatch", index=idx)
+                        result = call_fn(*call_args)
+                    if not isinstance(result, (tuple, list)):
+                        result = (result,)
+                    # D2H starts NOW, at dispatch, for both outfeed
+                    # modes — batch idx's copy overlaps the next
+                    # dispatches
+                    _start_host_copies(result)
+                    return result, n_pad
+                finally:
+                    if pin is not None:
+                        pin.release()
+                        pins.discard(pin)
 
             if fused:
                 report.count("fused_dispatches")
@@ -1218,9 +1370,9 @@ class Frame:
                             # shapes drifted between microbatches
                             # (variable-geometry pack): dispatch this
                             # group per-batch
-                            for packed, n_pad in group:
+                            for packed, n_pad, pin in group:
                                 dispatch(_run_fn_direct(), packed,
-                                         consumed, n_pad)
+                                         consumed, n_pad, pin=pin)
                             continue
                         fused_fn = _fused_wrapper(
                             _run_fn(), fuse, n_args=len(input_cols),
@@ -1228,9 +1380,23 @@ class Frame:
                         dispatch(fused_fn, stacked, consumed, 0,
                                  fused=True)
                     else:
-                        packed, n_pad = next_prepared()
-                        dispatch(_run_fn_direct(), packed, consumed,
-                                 n_pad)
+                        packed, n_pad, pin = next_prepared()
+                        if pin is not None:
+                            # RESIDENT batch: never hand a donating
+                            # program the cached buffers (XLA would
+                            # reuse them, corrupting every later
+                            # replay) — the non-donating wrapper
+                            # variant runs instead. Only a codec
+                            # wrapper can carry donate_argnums on the
+                            # per-batch path, so only that combination
+                            # counts as a blocked donation.
+                            if donate_flag and plan is not None:
+                                _dc.count_donation_blocked()
+                            dispatch(_run_fn(), packed, consumed,
+                                     n_pad, pin=pin)
+                        else:
+                            dispatch(_run_fn_direct(), packed,
+                                     consumed, n_pad)
                 while window is not None and len(window):
                     handle(*window.pop())
             finally:
@@ -1253,6 +1419,13 @@ class Frame:
             # IS the interesting stall); only now does the run's
             # heartbeat leave the watchdog's scan list
             hb_run.__exit__(None, None, None)
+            # sweep device-cache pins an unwind stranded (a cancelled
+            # window future whose run() never started still holds its
+            # batch's pin) — release is idempotent per token; snapshot
+            # first, dispatch threads may still be discarding
+            for p in list(pins):
+                p.release()
+            pins.clear()
         # close out the run: wall time + publish totals into the
         # process-wide metrics registry (obs.snapshot() / JSONL sink)
         if plan is not None and plan.resolved():

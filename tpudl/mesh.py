@@ -138,14 +138,32 @@ def transfer_batch(tree, mesh: Mesh, axis: str = DATA_AXIS, *,
     that dim. Every mesh transfer in the codebase goes through here
     (``Frame.map_batches``, the estimator's sub-mesh trials,
     ``Trainer.fit`` — one path, no second ``device_put`` route to
-    drift)."""
+    drift).
+
+    A leaf ALREADY resident under the requested sharding (an HBM-tier
+    device-cache hit — DATA.md "Cache hierarchy") passes through
+    untouched: zero wire bytes, and crucially no ``np.asarray`` — the
+    old unconditional host staging would have GATHERED the resident
+    shard back to host just to re-ship it."""
     leaves, treedef = jax.tree.flatten(tree)
-    arrs = [np.asarray(x) for x in leaves]
     shardings = [
-        (stacked_batch_sharding(mesh, axis, a.ndim) if batch_dim == 1
-         else batch_sharding(mesh, axis, a.ndim))
-        for a in arrs]
-    return jax.tree.unflatten(treedef, jax.device_put(arrs, shardings))
+        (stacked_batch_sharding(mesh, axis, np.ndim(x)) if batch_dim == 1
+         else batch_sharding(mesh, axis, np.ndim(x)))
+        for x in leaves]
+    out: list = [None] * len(leaves)
+    to_put, to_put_sh, to_put_idx = [], [], []
+    for i, (x, sh) in enumerate(zip(leaves, shardings)):
+        if isinstance(x, jax.Array) and x.sharding == sh:
+            out[i] = x  # resident replay: no transfer, no host bounce
+        else:
+            to_put.append(np.asarray(x))
+            to_put_sh.append(sh)
+            to_put_idx.append(i)
+    if to_put:
+        placed = jax.device_put(to_put, to_put_sh)
+        for i, p in zip(to_put_idx, placed):
+            out[i] = p
+    return jax.tree.unflatten(treedef, out)
 
 
 def shard_batch(tree, mesh: Mesh, axis: str = DATA_AXIS):

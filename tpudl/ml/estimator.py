@@ -79,7 +79,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                  kerasLoss=None, kerasFitParams=None, mesh=None,
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
                  dispatchDepth=None, wireCodec=None, cacheDir=None,
-                 trialRetryPolicy=None):
+                 deviceCache=None, trialRetryPolicy=None):
         super().__init__()
         self._setDefault(kerasFitParams={"batch_size": 32, "epochs": 1,
                                          "verbose": 0})
@@ -98,6 +98,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         # ship 4× fewer host->device bytes.
         self.wireCodec = wireCodec
         self.cacheDir = cacheDir
+        # HBM-tier bulk residency (DATA.md "Cache hierarchy"): the
+        # loaded X/y land on the trial's device ONCE and every epoch
+        # past the first indexes batches ON DEVICE — a multi-epoch fit
+        # ships the dataset over the wire exactly once. None = the
+        # TPUDL_DATA_DEVICE_CACHE env knob; rides into the returned
+        # transformer's map_batches device cache too.
+        self.deviceCache = deviceCache
         # per-trial retry (tpudl.jobs.RetryPolicy): a TRANSIENT trial
         # failure re-attempts on its slice instead of failing the whole
         # fitMultiple sweep (TrialScheduler.run's retry= contract; None
@@ -115,7 +122,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         kwargs.pop("mesh", None)
         for k in ("prefetchDepth", "prepareWorkers", "fuseSteps",
                   "dispatchDepth", "wireCodec", "cacheDir",
-                  "trialRetryPolicy"):
+                  "deviceCache", "trialRetryPolicy"):
             kwargs.pop(k, None)
         self._set(**kwargs)
 
@@ -238,72 +245,126 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         devs = list(devices) if devices is not None else None
         submesh = (M.build_mesh(devices=devs)
                    if devs is not None and len(devs) > 1 else None)
-        if submesh is not None:
-            params = M.replicate(gin.params, submesh)
-        elif devs is not None:
-            params = jax.device_put(gin.params, devs[0])
-        else:
-            params = jax.tree.map(jnp.asarray, gin.params)
-        opt_state = entry.optimizer.init(params)
-        opt_state.hyperparams["learning_rate"] = jnp.asarray(
-            lr if lr is not None else entry.default_lr, dtype=jnp.float32)
+        # HBM-tier bulk residency (the multi-epoch bulk path of ISSUE
+        # 12): place X/y on the trial's device ONCE under the shared
+        # device-cache budget — epochs ≥ 2 then index batches on
+        # device (a gather ships only indices, zero dataset bytes).
+        # Single-device trials only: a sub-mesh trial's sharded batch
+        # assembly keeps the per-step transfer edge. Bitwise-neutral:
+        # X_dev[idx] hands the SAME values to the SAME compiled step.
+        device_resident = False
+        bulk_pin = None
+        dc_on = (bool(self.deviceCache) if self.deviceCache is not None
+                 else os.environ.get("TPUDL_DATA_DEVICE_CACHE", "0")
+                 == "1")
+        if dc_on and submesh is None:
+            from tpudl.data import device_cache as _dc
 
-        rng = np.random.default_rng(seed)
-        n = len(X)
-        if n == 0:
-            raise ValueError("cannot fit on an empty frame (0 images)")
-        # fixed-size batches only → one compiled step program; the ragged
-        # tail wraps around (standard TPU static-shape practice). On a
-        # sub-mesh batch_size is rounded UP to a multiple of the slice
-        # width and batches stride by that size, drawing FRESH rows — not
-        # per-batch row duplication, which would double-weight the padding
-        # rows in the mean loss and make identical hyperparams train
-        # differently on different-width slices.
-        width = len(devs) if submesh is not None else 1
-        target = math.ceil(batch_size / width) * width
-        losses = []
-        n_steps = 0
-        with _obs_watchdog.heartbeat("estimator.train_trial",
-                                     epochs=epochs,
-                                     steps_total=epochs * -(-n // target)
-                                     ) as hb, \
-                _obs_tracer.span("estimator.train_trial", epochs=epochs,
-                                 batch_size=target, slice_width=width):
-            for _epoch in range(epochs):
-                order = rng.permutation(n) if shuffle else np.arange(n)
-                batch_losses = []  # device-resident; ONE fetch per epoch
-                for start in range(0, n, target):
-                    # one beat per train step: a hung step flags a
-                    # stall naming the epoch/step it froze at
-                    hb.beat(epoch=_epoch, step=n_steps)
-                    idx = order[start:start + target]
-                    if len(idx) < target:
-                        reps = math.ceil((target - len(idx)) / n)
-                        fill = np.concatenate(
-                            [order] * reps)[: target - len(idx)]
-                        idx = np.concatenate([idx, fill])
-                    xb, yb = X[idx], y[idx]
-                    if submesh is not None:
-                        # one batched async transfer for the step pair,
-                        # through THE mesh transfer edge
-                        # (mesh.transfer_batch — no second device_put
-                        # path to drift from the frame executor's)
-                        xb, yb = M.shard_batch((xb, yb), submesh)
-                    elif devs is not None:
-                        xb, yb = jax.device_put((xb, yb), devs[0])
-                    params, opt_state, loss = entry.step(
-                        params, opt_state, xb, yb)
-                    batch_losses.append(loss)
-                    n_steps += 1
-                # the epoch's loss is the MEAN over its batches (one
-                # batch's noise is a misleading trial score for
-                # CrossValidator)
-                losses.append(float(jnp.mean(jnp.stack(batch_losses))))
+            tgt = devs[0] if devs else None
+            # content tokens live in the RUN component (key[0]) so a
+            # NEW dataset's bulk can LRU-evict a finished one's (a run
+            # never evicts its own entries); the pin below releases at
+            # trial end for the same reason
+            bulk_key = (f"estimator-bulk|{_dc.array_token(X)}|"
+                        f"{_dc.array_token(y)}|{tgt!r}", 0)
+            bulk_pin = _dc.bulk_resident(bulk_key, (X, y), device=tgt)
+            if bulk_pin is not None:
+                X, y = bulk_pin.arrays
+                device_resident = True
+        # EVERYTHING past the bulk acquisition runs under the
+        # releasing finally: a params-placement / optimizer-init
+        # failure (device OOM is likelier with the dataset just
+        # pinned) must not leak a permanent pin that strands the
+        # dataset in the process-wide budget — doubly so under a
+        # trialRetryPolicy, where each retried failure would leak
+        # another
+        try:
+            if submesh is not None:
+                params = M.replicate(gin.params, submesh)
+            elif devs is not None:
+                params = jax.device_put(gin.params, devs[0])
+            else:
+                params = jax.tree.map(jnp.asarray, gin.params)
+            opt_state = entry.optimizer.init(params)
+            opt_state.hyperparams["learning_rate"] = jnp.asarray(
+                lr if lr is not None else entry.default_lr,
+                dtype=jnp.float32)
+
+            rng = np.random.default_rng(seed)
+            n = len(X)
+            if n == 0:
+                raise ValueError(
+                    "cannot fit on an empty frame (0 images)")
+            # fixed-size batches only → one compiled step program; the
+            # ragged tail wraps around (standard TPU static-shape
+            # practice). On a sub-mesh batch_size is rounded UP to a
+            # multiple of the slice width and batches stride by that
+            # size, drawing FRESH rows — not per-batch row
+            # duplication, which would double-weight the padding rows
+            # in the mean loss and make identical hyperparams train
+            # differently on different-width slices.
+            width = len(devs) if submesh is not None else 1
+            target = math.ceil(batch_size / width) * width
+            losses = []
+            n_steps = 0
+            with _obs_watchdog.heartbeat("estimator.train_trial",
+                                         epochs=epochs,
+                                         steps_total=epochs
+                                         * -(-n // target)) as hb, \
+                    _obs_tracer.span("estimator.train_trial",
+                                     epochs=epochs, batch_size=target,
+                                     slice_width=width):
+                for _epoch in range(epochs):
+                    order = (rng.permutation(n) if shuffle
+                             else np.arange(n))
+                    batch_losses = []  # device-resident; ONE epoch fetch
+                    for start in range(0, n, target):
+                        # one beat per train step: a hung step flags a
+                        # stall naming the epoch/step it froze at
+                        hb.beat(epoch=_epoch, step=n_steps)
+                        idx = order[start:start + target]
+                        if len(idx) < target:
+                            reps = math.ceil((target - len(idx)) / n)
+                            fill = np.concatenate(
+                                [order] * reps)[: target - len(idx)]
+                            idx = np.concatenate([idx, fill])
+                        xb, yb = X[idx], y[idx]
+                        if device_resident:
+                            # X/y live on the trial's device: the
+                            # gather above ran there, no transfer
+                            pass
+                        elif submesh is not None:
+                            # one batched async transfer for the step
+                            # pair, through THE mesh transfer edge
+                            # (mesh.transfer_batch — no second
+                            # device_put path to drift from the frame
+                            # executor's)
+                            xb, yb = M.shard_batch((xb, yb), submesh)
+                        elif devs is not None:
+                            xb, yb = jax.device_put((xb, yb), devs[0])
+                        params, opt_state, loss = entry.step(
+                            params, opt_state, xb, yb)
+                        batch_losses.append(loss)
+                        n_steps += 1
+                    # the epoch's loss is the MEAN over its batches
+                    # (one batch's noise is a misleading trial score
+                    # for CrossValidator)
+                    losses.append(
+                        float(jnp.mean(jnp.stack(batch_losses))))
+        finally:
+            if bulk_pin is not None:
+                # the bulk stays resident (warm for a re-fit) but
+                # UNPINNED: a later dataset's bulk may LRU-evict it —
+                # a finished fit must not strand HBM in the budget
+                bulk_pin.release()
         _obs_metrics.counter("estimator.trials").inc()
         _obs_metrics.counter("estimator.train_steps").inc(n_steps)
-        if codec is not None and n_steps:
+        if codec is not None and n_steps and not device_resident:
             # wire accounting (tpudl.data counters): encoded bytes per
-            # fixed-size step vs the float32 the prologue reconstitutes
+            # fixed-size step vs the float32 the prologue reconstitutes.
+            # Resident trials skip this — their dataset crossed the
+            # wire exactly once at bulk placement (data.hbm counters),
+            # and per-step gathers ship only indices.
             row = int(X.nbytes) / max(1, len(X))
             shipped_bytes = int(n_steps * target * row)
             dense = int(n_steps * target * (X.size / max(1, len(X))) * 4)
@@ -336,7 +397,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             mesh=self.mesh, prefetchDepth=self.prefetchDepth,
             prepareWorkers=self.prepareWorkers, fuseSteps=self.fuseSteps,
             dispatchDepth=self.dispatchDepth,
-            wireCodec=self.wireCodec, cacheDir=self.cacheDir)
+            wireCodec=self.wireCodec, cacheDir=self.cacheDir,
+            deviceCache=self.deviceCache)
 
     # -- fit entry points --------------------------------------------------
     def _ingest(self):

@@ -31,7 +31,7 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                  imageLoader=None, outputMode="vector", batchSize=64,
                  mesh=None, prefetchDepth=None, prepareWorkers=None,
                  fuseSteps=None, dispatchDepth=None, wireCodec=None,
-                 cacheDir=None):
+                 cacheDir=None, deviceCache=None):
         super().__init__()
         self._setDefault(outputMode="vector")
         self.batchSize = int(batchSize)

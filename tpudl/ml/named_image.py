@@ -182,7 +182,7 @@ class DeepImageFeaturizer(_NamedImageTransformer):
                  weights="random", batchSize=64, mesh=None,
                  computeDtype="float32", prefetchDepth=None,
                  prepareWorkers=None, fuseSteps=None, dispatchDepth=None,
-                 wireCodec=None, cacheDir=None):
+                 wireCodec=None, cacheDir=None, deviceCache=None):
         super().__init__()
         self.weights = weights
         self.batchSize = int(batchSize)
@@ -217,7 +217,8 @@ class DeepImagePredictor(_NamedImageTransformer):
                  decodePredictions=False, topK=5, weights="random",
                  batchSize=64, mesh=None, computeDtype="float32",
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
-                 dispatchDepth=None, wireCodec=None, cacheDir=None):
+                 dispatchDepth=None, wireCodec=None, cacheDir=None,
+                 deviceCache=None):
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5)
         self.weights = weights

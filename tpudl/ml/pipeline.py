@@ -88,6 +88,7 @@ class Transformer(Params):
             "dispatch_depth": getattr(self, "dispatchDepth", None),
             "wire_codec": getattr(self, "wireCodec", None),
             "cache_dir": getattr(self, "cacheDir", None),
+            "device_cache": getattr(self, "deviceCache", None),
         }
 
     def _set_pipeline_opts(self, kwargs: dict):
@@ -101,6 +102,7 @@ class Transformer(Params):
         self.dispatchDepth = kwargs.pop("dispatchDepth", None)
         self.wireCodec = kwargs.pop("wireCodec", None)
         self.cacheDir = kwargs.pop("cacheDir", None)
+        self.deviceCache = kwargs.pop("deviceCache", None)
 
 
 class Model(Transformer):

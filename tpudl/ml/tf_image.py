@@ -123,7 +123,8 @@ class TFImageTransformer(ImageBatchWarmup, Transformer, HasInputCol,
                  inputTensor=None, outputTensor=None, channelOrder="RGB",
                  outputMode="vector", batchSize=64, mesh=None,
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
-                 dispatchDepth=None, wireCodec=None, cacheDir=None):
+                 dispatchDepth=None, wireCodec=None, cacheDir=None,
+                 deviceCache=None):
         super().__init__()
         self._setDefault(channelOrder="RGB", outputMode="vector")
         self.batchSize = int(batchSize)

@@ -53,7 +53,7 @@ STATUS_PREFIX = "tpudl-status-"
 
 _METRIC_PREFIXES = ("train.", "hpo.", "udf.", "estimator.",
                     "obs.watchdog.", "obs.roofline.",
-                    "frame.map_batches.", "retry.")
+                    "frame.map_batches.", "retry.", "data.hbm.")
 
 
 def _status_dir() -> str | None:
@@ -96,7 +96,8 @@ def _run_entry(report: dict) -> dict:
         "queue_depth_mean": report.get("queue_depth_mean"),
         "config": {k: report.get(k) for k in (
             "executor", "batch_size", "fuse_steps", "prefetch_depth",
-            "prepare_workers", "wire_codec", "batch_cache")
+            "prepare_workers", "wire_codec", "batch_cache",
+            "device_cache")
             if report.get(k) is not None},
     }
     if rows_total:
@@ -163,11 +164,55 @@ def collect_status(roofline: bool = True) -> dict:
         payload["metrics"] = {
             name: m for name, m in _metrics.snapshot().items()
             if name.startswith(_METRIC_PREFIXES)}
+        hbm = _hbm_section(payload["metrics"], payload["ts"])
+        if hbm is not None:
+            payload["hbm"] = hbm
     # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
     # contributor drops its section, never the whole status file
     except Exception:
         pass
     return payload
+
+
+# hits/s needs a delta: the writer ticks at a fixed cadence, so one
+# (ts, hits) pair of module state per process is enough — no lock
+# (the 1 Hz writer is the only caller; a torn read worst-cases one
+# frame's rate to None)
+_HBM_RATE_STATE: dict = {}
+
+
+def _hbm_section(metrics: dict, now: float) -> dict | None:
+    """The status file's HBM residency line (ISSUE 12): bytes resident
+    vs budget, hit/miss/eviction totals, and a hits/s rate — a
+    budget-thrashing job (evictions climbing, hit rate sagging) is
+    visible LIVE instead of only in post-hoc counters. None when the
+    device cache never armed in this process."""
+    def val(name):
+        entry = metrics.get(name) or {}
+        v = entry.get("value")
+        return v if isinstance(v, (int, float)) else None
+
+    resident = val("data.hbm.bytes_resident")
+    if resident is None:
+        return None
+    budget = val("data.hbm.budget_bytes")
+    hits = val("data.hbm.hits") or 0
+    out = {
+        "bytes_resident": int(resident),
+        "budget_bytes": int(budget) if budget else None,
+        "budget_pct": (round(100.0 * resident / budget, 1)
+                       if budget else None),
+        "hits": int(hits),
+        "misses": int(val("data.hbm.misses") or 0),
+        "evictions": int(val("data.hbm.evictions") or 0),
+        "hits_per_s": None,
+    }
+    prev = _HBM_RATE_STATE.get("tick")
+    _HBM_RATE_STATE["tick"] = (now, hits)
+    if prev and now > prev[0]:
+        out["hits_per_s"] = round(
+            max(0.0, hits - prev[1]) / (now - prev[0]), 1)
+    return out
 
 
 def write_status(status_dir: str | None = None,
@@ -391,6 +436,25 @@ def render(statuses: list[dict], now: float | None = None) -> str:
                 parts.append(f"{name} {hb.get('age_s')}s"
                              f"{suspect}{flag}")
             lines.append("  heartbeats: " + "; ".join(parts))
+        hbm = st.get("hbm") or {}
+        if hbm.get("bytes_resident") is not None:
+            mb = hbm["bytes_resident"] / 2**20
+            budget = hbm.get("budget_bytes")
+            pct = hbm.get("budget_pct")
+            rate = hbm.get("hits_per_s")
+            line = f"  hbm:        {mb:.1f}"
+            if budget:
+                line += f"/{budget / 2**20:.1f} MB resident"
+                if pct is not None:
+                    line += f" ({pct:.0f}%)"
+            else:
+                line += " MB resident"
+            line += f"  hits {hbm.get('hits', 0)}"
+            if rate is not None:
+                line += f" ({rate:.1f}/s)"
+            if hbm.get("evictions"):
+                line += f"  evictions {hbm['evictions']}"
+            lines.append(line)
         rl = st.get("roofline") or {}
         if rl.get("verdict"):
             lines.append(f"  roofline:   {rl['verdict']}")

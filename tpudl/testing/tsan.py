@@ -497,6 +497,15 @@ def check_guarded(lock_name: str, structure: str = "", lock=None):
     if not ENABLED:
         return
     st = _state()
+    if getattr(st.tls, "reporting", False):
+        # the finding-recording hop itself (metrics counter + flight
+        # breadcrumb, _file_finding's mute window): registering the
+        # first tsan.* counter MUTATES the metrics registry map, whose
+        # own lockset probe would fire here when the registry's guard
+        # predates arming (a plain pre-armed Lock is invisible to
+        # held()). Same principle as the edge-noting mute: the
+        # sanitizer never reports its own reporting path.
+        return
     held = st.held()
     if lock is not None:
         if any(e[0] is lock for e in held):

@@ -37,7 +37,8 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
                  fuse_steps: int | None = None,
                  dispatch_depth: int | None = None,
                  wire_codec=None,
-                 cache_dir: str | None = None) -> UDF:
+                 cache_dir: str | None = None,
+                 device_cache: bool | None = None) -> UDF:
     """Register ``graph`` as a SQL UDF named ``udf_name``.
 
     ``graph``: a :class:`tpudl.ingest.TFInputGraph` (any factory route,
@@ -53,9 +54,12 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
     pipelined-executor knobs (None = the ``TPUDL_FRAME_*`` env /
     autotune defaults), so SQL-registered models ride the
     same staged pipeline as the ml transformers; ``wire_codec`` /
-    ``cache_dir`` plumb the tpudl.data knobs the same way (DATA.md —
-    wire-encoded uploads and the sharded prepared-batch cache), so a
-    SQL query over the same frame replays its prepared batches too.
+    ``cache_dir`` / ``device_cache`` plumb the tpudl.data knobs the
+    same way (DATA.md — wire-encoded uploads, the sharded
+    prepared-batch cache, and HBM-tier batch residency), so a repeated
+    SQL query over the same frame replays its prepared batches — from
+    device memory, with zero wire bytes, when the device cache is
+    armed.
 
     SQL's ``fn(col)`` grammar binds single-input graphs; multi-input
     graphs still register and are callable as ``udf(frame)`` with every
@@ -127,7 +131,8 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
                 prefetch_depth=prefetch_depth,
                 prepare_workers=prepare_workers, fuse_steps=fuse_steps,
                 dispatch_depth=dispatch_depth,
-                wire_codec=wire_codec, cache_dir=cache_dir)
+                wire_codec=wire_codec, cache_dir=cache_dir,
+                device_cache=device_cache)
         _obs_metrics.counter(f"udf.{udf_name}.calls").inc()
         _obs_metrics.counter(f"udf.{udf_name}.rows").inc(len(frame))
         return out
