@@ -264,12 +264,15 @@ def _compact_summary(record: dict) -> dict:
     per sub-bench, nothing nested deeper than one level."""
     s = {k: record.get(k) for k in ("metric", "value", "unit",
                                     "vs_baseline")}
+    from tpudl.testing import traceck as _traceck
     from tpudl.testing import tsan as _tsan
 
-    # main() refuses to start armed, so this is always false on a
-    # judged line — recorded anyway so a stray TPUDL_TSAN=1 can never
-    # silently tax the numbers without showing on the record
+    # main() refuses to start armed, so these are always false on a
+    # judged line — recorded anyway so a stray TPUDL_TSAN=1 /
+    # TPUDL_TRACECK=1 can never silently tax the numbers without
+    # showing on the record
     s["tsan_armed"] = bool(_tsan.enabled())
+    s["traceck_armed"] = bool(_traceck.enabled())
     for k in ("headline_mode", "compute_dtype", "batch_size",
               "deadline_hit", "partial", "sigterm"):
         if k in record:
@@ -1334,6 +1337,8 @@ def measure_data_pipeline():
     col[:] = list(f32)
     frame = Frame({"x": col})
     # light compute on purpose: the arm difference is the WIRE
+    # tpudl: ignore[jit-cache-churn] — one program per sub-bench process
+    # run by design; bench.py measures, it does not serve
     fn = jax.jit(lambda x: x.reshape(x.shape[0], -1).mean(axis=1))
     out = {"n": n, "image_hw": h, "batch": batch}
 
@@ -1446,6 +1451,8 @@ def measure_device_cache():
     frame = Frame({"x": x})
     # wire-shaped on purpose: light compute, image-sized inputs — the
     # epoch difference is the H2D transfer residency removes
+    # tpudl: ignore[jit-cache-churn] — one program per sub-bench process
+    # run by design; bench.py measures, it does not serve
     fn = jax.jit(lambda b: b.reshape(b.shape[0], -1).mean(axis=1))
     out = {"n": n, "image_hw": h, "batch": batch}
 
@@ -1518,6 +1525,8 @@ def measure_async_dispatch():
     frame = Frame({"x": x})
     # dispatch-latency-shaped on purpose: light compute, small outputs —
     # the arm difference is the per-dispatch round-trip the window hides
+    # tpudl: ignore[jit-cache-churn] — one program per sub-bench process
+    # run by design; bench.py measures, it does not serve
     fn = jax.jit(lambda b: b.reshape(b.shape[0], -1).mean(axis=1))
     out = {"n": n, "batch": batch, "dispatch_depth": depth}
 
@@ -1588,6 +1597,8 @@ def run_mesh_child(out_path):
             y = jnp.tanh(y * 0.25 + 0.1)
         return y.mean(axis=1)
 
+    # tpudl: ignore[jit-cache-churn] — one program per mesh-child
+    # subprocess by design; bench.py measures, it does not serve
     jfn = jax.jit(featurize)
     mesh = M.build_mesh(n_data=8)
     kw = dict(batch_size=batch, fuse_steps=4, dispatch_depth=4,
@@ -1857,8 +1868,13 @@ def measure_flash_attention():
         q, k, v = (jnp.asarray(
             rng.normal(size=(b, s, h, d)).astype(np.float32))
             for _ in range(3))
+        # tpudl: ignore[jit-cache-churn] — a fresh program per rung of
+        # the sequence-length ladder IS the sub-bench (each shape
+        # compiles its own kernel); the trace cost is outside the timer
         flash = jax.jit(lambda a, x, y: jnp.sum(
             flash_attention(a, x, y, causal=True, interpret=interpret)))
+        # tpudl: ignore[jit-cache-churn] — same ladder contract as the
+        # flash arm above: per-shape programs, traced outside the timer
         dense = jax.jit(lambda a, x, y: jnp.sum(
             attention_reference(a, x, y, causal=True)))
 
@@ -2098,6 +2114,15 @@ def main():
         # Refuse loudly instead of benching slow (CONCURRENCY.md).
         print("bench: refusing to run judged rounds with the lock "
               "sanitizer armed (unset TPUDL_TSAN)", file=sys.stderr)
+        raise SystemExit(1)
+    from tpudl.testing import traceck as _traceck
+
+    if _traceck.enabled():
+        # same contract for the recompile-storm sentinel: its jax.jit
+        # shim adds a bookkeeping hop per trace, and judged numbers
+        # must never carry an invisible tax (ANALYSIS.md)
+        print("bench: refusing to run judged rounds with the traceck "
+              "sentinel armed (unset TPUDL_TRACECK)", file=sys.stderr)
         raise SystemExit(1)
     dtype = os.environ.get("TPUDL_BENCH_DTYPE", "bfloat16")
     log(f"compute dtype: {dtype} (standard TPU inference precision; "

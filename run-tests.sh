@@ -19,6 +19,13 @@ echo "== tsan pass (lock sanitizer armed over the concurrency subset) =="
 TPUDL_TSAN=1 TPUDL_FLIGHT_DIR="$(mktemp -d)" \
     python -m pytest tests/test_concurrency.py -q "$@" -m concurrency
 
+echo "== traceguard subset (jit-boundary rules + traceck sentinel) =="
+# Target the traceguard module DIRECTLY (same rationale as the armed
+# concurrency subset above: an unrelated jax-version collection error
+# exits pytest 1 under set -e). The armed-sentinel cases run in
+# subprocesses the tests spawn themselves, so no env is set here.
+python -m pytest tests/test_traceguard.py -q "$@"
+
 echo "== virtual-mesh executor subset (ISSUE 11 acceptance) =="
 # Target the mesh-executor module DIRECTLY (same rationale as the
 # armed concurrency subset above): a jax-version collection error in

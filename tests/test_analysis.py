@@ -668,7 +668,12 @@ class TestSuppressionContract:
             "unlocked-global",
             # the interprocedural concurrency rules (CONCURRENCY.md)
             "lock-order", "lock-held-blocking", "signal-lock",
-            "daemon-shared-write"}
+            "daemon-shared-write",
+            # the jit-boundary trace rules (ANALYSIS.md, traceguard)
+            "trace-time-effect", "host-op-on-traced", "traced-branch",
+            "donation-reuse", "jit-cache-churn",
+            # the gate's suppression self-audit (tools/tpudl_check.py)
+            "stale-suppression"}
         for rule, desc in RULES.items():
             assert desc, rule
 

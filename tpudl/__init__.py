@@ -9,8 +9,15 @@ jitted programs on a TPU mesh.
 """
 
 import importlib
+import os as _os
 
 from tpudl.version import __version__
+
+if _os.environ.get("TPUDL_TRACECK", "0") == "1":
+    # recompile-storm sentinel (tpudl.testing.traceck): install the
+    # jax.jit counting shim BEFORE any product module binds jax.jit
+    # into a decorator/partial/local — import order IS the contract
+    from tpudl.testing import traceck as _traceck  # noqa: F401
 
 # symbol → defining module. Extended as layers land; __all__ derives from it
 # so star-import never advertises a module that does not exist yet.

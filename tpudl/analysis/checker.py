@@ -38,8 +38,9 @@ from dataclasses import dataclass, field
 from . import knobs as _knobs
 from . import metric_names as _metric_names
 
-__all__ = ["Finding", "RULES", "check_source", "check_file",
-           "check_paths", "collect_usage", "iter_python_files"]
+__all__ = ["Finding", "RULES", "Suppression", "check_source",
+           "check_file", "check_paths", "collect_usage",
+           "iter_python_files"]
 
 RULES: dict[str, str] = {
     "hot-sync": "blocking device sync (block_until_ready/.item()/"
@@ -73,6 +74,32 @@ RULES: dict[str, str] = {
     "daemon-shared-write": "attribute/global written from both a "
                            "thread-reachable function and foreground "
                            "code with no common lock",
+    # the five TRACE rules (tpudl.analysis.traceguard — the jit
+    # boundary: which functions are traced, and what must never happen
+    # inside them)
+    "trace-time-effect": "host side effect (obs counter/gauge, flight "
+                         "breadcrumb, env read, print/logging) inside "
+                         "traced code — it runs ONCE at trace time and "
+                         "silently lies per-step thereafter",
+    "host-op-on-traced": "np.* call or .item()/float()/int() host "
+                         "coercion on a traced value inside traced "
+                         "code (breaks tracing or forces a sync)",
+    "traced-branch": "Python if/while on a traced value inside traced "
+                     "code (ConcretizationError; use lax.cond/"
+                     "lax.select/jnp.where)",
+    "donation-reuse": "a buffer passed to a donating jitted wrapper "
+                      "and read again afterwards in the same scope "
+                      "(the donated buffer is dead)",
+    "jit-cache-churn": "jit/wrap program built per call or per loop "
+                       "iteration (fresh closure defeats the "
+                       "_fused_wrapper retention pattern), or called "
+                       "with unhashable static args — every call "
+                       "retraces (~60 s per recompile, ROADMAP 3)",
+    # the gate's self-audit (tools/tpudl_check.py full runs only)
+    "stale-suppression": "an '# tpudl: ignore[rule]' comment whose "
+                         "line no longer produces a finding under "
+                         "that rule (the suppression has rotted as "
+                         "code moved)",
 }
 
 _HINTS: dict[str, str] = {
@@ -104,6 +131,26 @@ _HINTS: dict[str, str] = {
                    "thread",
     "daemon-shared-write": "take the structure's named_lock at BOTH "
                            "write sites, or make one side copy-on-read",
+    "trace-time-effect": "move the effect outside the traced fn (count "
+                         "at the dispatch site, read env before "
+                         "wrapping), or use jax.debug.print/callback "
+                         "for genuine per-step effects",
+    "host-op-on-traced": "use the jnp./lax. equivalent on device; "
+                         "materialize AFTER the program returns (and "
+                         "outside hot stages — see hot-sync)",
+    "traced-branch": "branch on static shape/dtype info, hoist the "
+                     "predicate to a static arg, or rewrite with "
+                     "lax.cond/lax.select/jnp.where",
+    "donation-reuse": "copy before donating, route through the "
+                      "non-donating wrapper variant (PR 12's "
+                      "donation_blocked fallback), or stop reading "
+                      "the buffer after dispatch",
+    "jit-cache-churn": "hoist the jit to module scope or cache the "
+                       "wrapper on the fn (_fused_wrapper retention "
+                       "pattern: fn._tpudl_fused[key]); keep static "
+                       "args hashable (tuples, not lists)",
+    "stale-suppression": "delete the ignore comment, or re-anchor it "
+                         "to the line that still produces the finding",
 }
 
 _KNOB_RE = re.compile(r"TPUDL_[A-Z0-9_]+\Z")
@@ -141,6 +188,20 @@ class Finding:
 
 
 @dataclass
+class Suppression:
+    """One ``# tpudl: ignore[rules] — reason`` comment. A single object
+    is registered at every line it covers (its own line and the next
+    code line), so a finding absorbed at either marks the SAME record
+    used — the stale-suppression audit (tools/tpudl_check.py) reports
+    records whose rules never absorbed anything."""
+    rules: set            # valid rule ids named in the bracket
+    reason: str
+    line: int             # the comment's own line (the audit anchor)
+    col: int = 0
+    used: set = field(default_factory=set)  # rule ids that absorbed
+
+
+@dataclass
 class _Ctx:
     """Lexical context threaded through the walk."""
     func: ast.AST | None = None        # enclosing function node
@@ -158,8 +219,8 @@ class _FileChecker:
         self.rel = relpath.replace(os.sep, "/")
         self.lines = src.splitlines()
         self.findings: list[Finding] = []
-        # line -> [(rule-set|None=all, reason)]
-        self.suppressions: dict[int, list[tuple[set | None, str]]] = {}
+        # line -> [Suppression] (one record may appear under two lines)
+        self.suppressions: dict[int, list[Suppression]] = {}
         self.hot_lines: set[int] = set()
         self.docstring_positions: set[tuple[int, int]] = set()
         self.used_knobs: set[str] = set()
@@ -202,13 +263,16 @@ class _FileChecker:
                     # ignore must not become a suppress-everything that
                     # hides the line's genuine findings
                     if valid:
+                        rec = Suppression(rules=valid, reason=reason,
+                                          line=line, col=tok.start[1])
                         self.suppressions.setdefault(target, []).append(
-                            (valid, reason))
+                            rec)
                         if standalone:
                             # also cover the comment's own line so a
-                            # same-line OR line-above placement both work
+                            # same-line OR line-above placement both
+                            # work (same record: usage marks once)
                             self.suppressions.setdefault(line, []).append(
-                                (valid, reason))
+                                rec)
                 if _HOT_RE.search(tok.string):
                     self.hot_lines.add(target)
                     self.hot_lines.add(line)
@@ -220,9 +284,13 @@ class _FileChecker:
               suppressible: bool = True, also_lines: tuple = ()):
         if suppressible:
             for ln in (line, *also_lines):
-                for rules, reason in self.suppressions.get(ln, []):
-                    if rules is None or rule in rules:
-                        if not reason:
+                for sup in self.suppressions.get(ln, []):
+                    if rule in sup.rules:
+                        # a reasonless match still ABSORBED the finding
+                        # (used for the stale audit) — but is its own
+                        # finding: the reason is required
+                        sup.used.add(rule)
+                        if not sup.reason:
                             self.findings.append(Finding(
                                 self.rel, ln, col, rule,
                                 f"suppression for [{rule}] is missing "
@@ -722,30 +790,47 @@ def iter_python_files(paths) -> list[str]:
 
 
 def check_paths(paths, root: str = ".",
-                sources: dict | None = None) -> tuple[list[Finding],
-                                                      list[str]]:
+                sources: dict | None = None,
+                supp_sink: dict | None = None) -> tuple[list[Finding],
+                                                        list[str]]:
     """(findings, errors) over files/dirs. Errors are unreadable or
     unparseable files — the CLI maps them to exit 1. Pass ``sources``
     (``{relpath: src}``, already read) to skip the file IO — the CLI
-    reads the tree once and feeds both checker halves."""
+    reads the tree once and feeds both checker halves. ``supp_sink``
+    (``{relpath: {line: [Suppression]}}``) receives each file's
+    suppression records with their usage marks — the stale-suppression
+    audit's evidence."""
     findings: list[Finding] = []
     errors: list[str] = []
     if sources is not None:
         for rel, src in sorted(sources.items()):
+            fc = _FileChecker(src, rel, rel)
             try:
-                findings.extend(_FileChecker(src, rel, rel).run())
+                findings.extend(fc.run())
             except _ParseError as e:
                 errors.append(str(e))
+                continue
+            if supp_sink is not None:
+                supp_sink[rel.replace(os.sep, "/")] = fc.suppressions
         return findings, errors
     for path in iter_python_files(paths):
+        rel = os.path.relpath(path, root)
         try:
-            findings.extend(check_file(path, root=root))
-        except _ParseError as e:
-            errors.append(str(e))
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
         except (OSError, UnicodeDecodeError) as e:
             # a non-UTF-8 source is an ERROR line + rc 1, not a
             # traceback through the lint gate
             errors.append(f"{path}: {e}")
+            continue
+        fc = _FileChecker(src, path, rel)
+        try:
+            findings.extend(fc.run())
+        except _ParseError as e:
+            errors.append(str(e))
+            continue
+        if supp_sink is not None:
+            supp_sink[rel.replace(os.sep, "/")] = fc.suppressions
     return findings, errors
 
 

@@ -772,10 +772,10 @@ class _Emitter:
         if self.rule_filter is not None and rule not in self.rule_filter:
             return
         for file, line in sites:
-            for rules, reason in self.suppressions.get(file, {}).get(
-                    line, []):
-                if rules is None or rule in rules:
-                    if not reason:
+            for sup in self.suppressions.get(file, {}).get(line, []):
+                if rule in sup.rules:
+                    sup.used.add(rule)
+                    if not sup.reason:
                         self.findings.append(Finding(
                             file, line, 0, rule,
                             f"suppression for [{rule}] is missing its "
@@ -1001,15 +1001,33 @@ def _run_rules(linker: _Linker, emitter: _Emitter):
 
 # -- public API --------------------------------------------------------
 
+def link_sources(sources: dict, modules: dict | None = None
+                 ) -> tuple["_Linker", dict, list]:
+    """Parse + comment-scan the tree ONCE: ``(linker, suppressions,
+    parse_errors)``. The CLI builds this once and hands it to both
+    interprocedural halves (concurrency + traceguard) so the gate
+    never re-parses per half; shared Suppression objects also merge
+    usage marks for free."""
+    return _link(sources, modules)
+
+
 def analyze_sources(sources: dict, rules=None,
-                    modules: dict | None = None) -> list[Finding]:
+                    modules: dict | None = None,
+                    supp_sink: dict | None = None,
+                    linked=None) -> list[Finding]:
     """Run the concurrency rules over in-memory sources
     (``{relpath: src}``) — the fixture entry point (and, via
-    ``modules``, the shared-source path the CLI uses)."""
-    linker, suppressions, errors = _link(sources, modules)
+    ``modules``, the shared-source path the CLI uses). ``supp_sink``
+    receives this pass's suppression records with usage marks (the
+    stale-suppression audit merges them across halves); ``linked``
+    (from :func:`link_sources`) skips the re-parse."""
+    linker, suppressions, errors = (linked if linked is not None
+                                    else _link(sources, modules))
     emitter = _Emitter(suppressions,
                        set(rules) if rules is not None else None)
     _run_rules(linker, emitter)
+    if supp_sink is not None:
+        supp_sink.update(suppressions)
     emitter.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return emitter.findings
 

@@ -122,6 +122,13 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_DEVICE_MS_PER_STEP", "float", "0", "obs",
          "measured device ms/step fed to the roofline model (0/unset "
          "= derive from the report)"),
+    Knob("TPUDL_TRACECK", "bool", "0", "obs",
+         "1 arms the recompile-storm sentinel (tpudl.testing.traceck): "
+         "jax.jit gains a trace-counting shim, retraces per fn "
+         "identity land in traceck.* metrics + the flight error ring"),
+    Knob("TPUDL_TRACECK_STORM", "int", "3", "obs",
+         "traces of one fn identity beyond which the sentinel files a "
+         "recompile_storm finding"),
     # -- jobs / train / retries (JOBS.md) ------------------------------
     Knob("TPUDL_RETRY_IO_ATTEMPTS", "int", "3", "jobs",
          "io_policy() total attempts per file operation (1 disables)"),

@@ -93,6 +93,10 @@ LOCKS: tuple[LockDecl, ...] = (
              "ever)"),
     LockDecl("testing.faults.arm", "tpudl.testing.faults", "lock",
              "module", 20, "fault-plan arm/disarm singleton"),
+    LockDecl("testing.traceck", "tpudl.testing.traceck", "lock",
+             "module", 20,
+             "traceck per-fn-identity trace counts + storm findings "
+             "(metrics/flight reporting happens AFTER release)"),
     LockDecl("ml.hpo.slices", "tpudl.ml.hpo", "lock", "module", 20,
              "free device-slice list under the trial thread pool "
              "(function-local; module scope = one per run_parallel "

@@ -185,6 +185,15 @@ METRICS: tuple[Metric, ...] = (
     Metric("tsan.lockset_violations", "counter",
            "armed sanitizer: registered structure mutated without its "
            "declared guard lock"),
+    Metric("traceck.traces", "counter",
+           "armed sentinel: jitted-fn traces observed (one per "
+           "compile)"),
+    Metric("traceck.retraces", "counter",
+           "armed sentinel: second-or-later traces of one fn identity "
+           "(each one a recompile)"),
+    Metric("traceck.storms", "counter",
+           "armed sentinel: identities tracing past "
+           "TPUDL_TRACECK_STORM (one recompile-storm finding each)"),
     Metric("obs.roofline.achieved_rows_per_s", "gauge",
            "measured end-to-end throughput (roofline input)"),
     Metric("obs.roofline.achievable_rows_per_s", "gauge",

@@ -1,0 +1,915 @@
+"""Interprocedural jit-boundary analyzer: who is traced, and the five
+contracts traced code must honor.
+
+Third analyzer half (ANALYSIS.md; per-file rules live in
+:mod:`tpudl.analysis.checker`, the lock graph in
+:mod:`tpudl.analysis.concurrency`, whose call-graph machinery this
+module reuses). The whole pipeline surface now runs through cached
+jitted programs — ``_fused_wrapper`` retention, ``CodecPlan.wrap``
+variants, mesh-fused ``lax.scan`` — and each of those contracts was,
+until this module, enforced only by convention and runtime counters.
+
+Phase 1 finds the **traced set**: a function is traced when it reaches
+a trace entry —
+
+- decorated ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``;
+- passed to ``jax.jit(f)`` / ``jax.pmap(f)`` / ``lax.scan(f, ...)``;
+- passed to the house wrappers ``_fused_wrapper(f, ...)`` or
+  ``<plan>.wrap(f, ...)``;
+- the first argument of a call site carrying a truthy ``device_fn=``
+  marker (the executor's explicit "this fn is a device program" flag);
+
+plus, transitively, anything a traced function calls (name-based
+may-analysis, the same call resolution as the lock graph —
+over-approximation is the design, and the sweep's fix-or-suppress
+pass is the accuracy mechanism, exactly PR 8/9's deal).
+
+Phase 2 runs five rules:
+
+- ``trace-time-effect``: obs counters/gauges/histograms, flight
+  breadcrumbs (``record_*``), env reads (``os.environ``/
+  ``os.getenv``), ``print``/logging inside traced code. These execute
+  ONCE at trace time: a counter bumped inside a fused prologue records
+  one increment for the whole life of the compiled program and
+  silently lies per-step thereafter.
+- ``host-op-on-traced``: ``np.*`` calls and ``.item()``/``float()``/
+  ``int()``/``bool()`` coercions applied to traced values — a host
+  round-trip (or a ConcretizationError) inside the program.
+- ``traced-branch``: Python ``if``/``while`` on a traced value.
+  Static-under-trace accesses (``x.shape``/``x.ndim``/``x.dtype``/
+  ``x.size``, ``len(x)``, ``isinstance``, ``is None``) are exempt —
+  shape dispatch is the house idiom, value dispatch is the bug.
+- ``donation-reuse``: a variable passed to a donating wrapper
+  (``_fused_wrapper(..., donate=)``, ``plan.wrap(..., donate=)``,
+  ``jax.jit(..., donate_argnums=)``) and read again afterwards in the
+  same scope — the static companion to the runtime
+  ``data.hbm.donation_blocked`` fallback (PR 12).
+- ``jit-cache-churn``: jit/wrap programs built inside loops or over
+  per-call closures (a fresh lambda/local def per invocation defeats
+  the ``fn._tpudl_fused[key]`` retention pattern — every call
+  retraces, ~60 s per recompile on the real chip, ROADMAP item 3),
+  and unhashable (list/dict/set literal) static arguments.
+
+Traced-value tracking is a per-function forward dataflow: parameters
+(minus ``self``/``cls`` and any the jit site marks static via
+``static_argnums``/``static_argnames``) seed the set; assignments
+whose right side references a traced value or calls into
+``jnp.*``/``jax.*``/``lax.*`` extend it.
+
+Suppression: the shared ``# tpudl: ignore[rule] — reason`` grammar,
+accepted at ANY witness site (the offending line, the traced
+function's ``def`` line, or the trace-entry site that made it traced).
+
+Runtime twin: :mod:`tpudl.testing.traceck` (``TPUDL_TRACECK=1``)
+counts actual retraces per fn identity and files recompile-storm
+findings — the seeded-storm test proves both halves fire from one
+source.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .checker import Finding, _HINTS  # noqa: F401  (re-export surface)
+from .concurrency import _Emitter, _Func, _dotted, _link, read_sources
+
+__all__ = ["TRACE_RULES", "TracedFn", "analyze", "analyze_sources",
+           "traced_functions"]
+
+TRACE_RULES = ("trace-time-effect", "host-op-on-traced", "traced-branch",
+               "donation-reuse", "jit-cache-churn")
+
+# dotted tails that construct a compiled program from their fn argument
+_JIT_DOTTED = {"jax.jit", "jit", "jax.pmap", "pmap"}
+_STATIC_KWARGS = ("static_argnums", "static_argnames")
+# attribute accesses that are STATIC under trace (shape dispatch)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# calls whose result is static even over traced args
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
+                 "callable", "id", "repr"}
+_LOG_TAILS = {"debug", "info", "warning", "error", "exception",
+              "critical", "log", "warn"}
+
+
+class TracedFn:
+    """Why one function is traced: the entry kind and witness site."""
+
+    __slots__ = ("key", "kind", "file", "line", "via", "static_params")
+
+    def __init__(self, key, kind, file, line, via=None):
+        self.key = key          # "<module>:<qual>"
+        self.kind = kind        # jit|scan|fused|wrap|device_fn|call
+        self.file = file        # trace-entry witness file
+        self.line = line        # trace-entry witness line
+        self.via = via          # caller qual for transitive entries
+        self.static_params: set = set()
+
+
+def _call_tail(d: str) -> str:
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+def _bind_targets(n) -> list:
+    """The binding targets of an Assign OR AnnAssign — an annotation
+    (`g: Callable = jax.jit(f)`) must not break maker/factory
+    recognition."""
+    return n.targets if isinstance(n, ast.Assign) else [n.target]
+
+
+def _truthy_const(node) -> bool:
+    return isinstance(node, ast.Constant) and bool(node.value)
+
+
+def _falsy_const(node) -> bool:
+    return isinstance(node, ast.Constant) and not node.value
+
+
+def _static_params_of(call: ast.Call, fnode) -> set:
+    """Parameter names a jit call marks static (the ones that are NOT
+    traced even though they are parameters)."""
+    out: set = set()
+    if fnode is None:
+        return out
+    params = [a.arg for a in fnode.args.posonlyargs + fnode.args.args]
+    for kw in call.keywords:
+        if kw.arg not in _STATIC_KWARGS:
+            continue
+        v = kw.value
+        elems = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for e in elems:
+            if isinstance(e, ast.Constant):
+                if isinstance(e.value, int) and not isinstance(
+                        e.value, bool) and 0 <= e.value < len(params):
+                    out.add(params[e.value])
+                elif isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _donate_positions(call: ast.Call):
+    """Donated arg positions of a donating-maker call, or None when the
+    call does not donate. ``all`` = every positional arg donated (the
+    house wrappers donate their whole input tree)."""
+    d = _dotted(call.func)
+    tail = _call_tail(d)
+    if tail in ("_fused_wrapper", "wrap"):
+        for kw in call.keywords:
+            if kw.arg == "donate" and not _falsy_const(kw.value):
+                return "all"    # donate=True or donate=<flag var>: may
+        return None
+    if d in _JIT_DOTTED:
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            # only None/False mean "no donation" — donate_argnums=0
+            # donates ARG 0 (an int zero is an argnum, not a flag)
+            if isinstance(v, ast.Constant) and \
+                    (v.value is None or v.value is False):
+                return None
+            if isinstance(v, (ast.Tuple, ast.List)):
+                if not v.elts:
+                    return None   # explicit donate-NOTHING: ()
+                elems = v.elts
+            else:
+                elems = [v]
+            pos = set()
+            unknown = False
+            for e in elems:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, int) and \
+                        not isinstance(e.value, bool):
+                    pos.add(e.value)
+                else:
+                    unknown = True
+            if pos:
+                return pos
+            # a non-literal spec (donate_argnums=<var>) MAY donate
+            # anything — the may-analysis default
+            return "all" if unknown else None
+    return None
+
+
+def _static_argnum_positions(call: ast.Call) -> set:
+    """Literal static_argnums positions visible at a jit call."""
+    pos: set = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnums":
+            continue
+        v = kw.value
+        elems = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for e in elems:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                pos.add(e.value)
+    return pos
+
+
+class _FnScope:
+    """One function's AST plus the bookkeeping phase 2 needs."""
+
+    __slots__ = ("key", "node", "file", "module", "qual", "func")
+
+    def __init__(self, key, node, file, module, qual, func):
+        self.key = key
+        self.node = node
+        self.file = file
+        self.module = module
+        self.qual = qual
+        self.func = func      # the linker's _Func (call resolution)
+
+
+def _iter_scopes(scan):
+    """Every function in a module scan, with the SAME qual scheme the
+    concurrency linker uses (class bodies reset qual to the class
+    name; nested defs join with '.') so keys line up."""
+    out = []
+
+    def walk(node, qual, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                fq = f"{qual}.{child.name}" if qual else child.name
+                out.append((fq, child, cls))
+                walk(child, fq, cls)
+            else:
+                walk(child, qual, cls)
+
+    walk(scan.tree, "", None)
+    return out
+
+
+class _TraceLinker:
+    """Phase 1: the traced set over the whole tree."""
+
+    def __init__(self, linker):
+        self.linker = linker
+        self.scopes: dict[str, _FnScope] = {}
+        self._scan_scopes: dict[int, list] = {}  # id(scan) -> scopes
+        for scan in linker.scans:
+            scoped = _iter_scopes(scan)
+            self._scan_scopes[id(scan)] = scoped
+            for fq, node, _cls in scoped:
+                f = scan.funcs.get(fq)
+                if f is None:
+                    continue
+                self.scopes[f.key] = _FnScope(
+                    f.key, node, scan.rel, scan.module, fq, f)
+        self.traced: dict[str, TracedFn] = {}
+
+    # -- trace-entry discovery ----------------------------------------
+    def _module_ctx(self, scan) -> _Func:
+        return _Func(key=f"{scan.module}:<module>", module=scan.module,
+                     qual="", cls=None, file=scan.rel, line=0,
+                     name="<module>")
+
+    def resolve(self, desc, f: _Func) -> list[_Func]:
+        """The linker's call resolution, minus its bare-method-name
+        fallback for EXTERNAL module attributes: ``jnp.log`` /
+        ``jax.lax.scan`` must not resolve to some repo function that
+        happens to be named ``log``/``scan`` — one such mismatch marks
+        a whole host subsystem traced and floods the sweep."""
+        _, d = desc
+        if "." in d:
+            head = d.split(".", 1)[0]
+            s = self.linker.by_module.get(f.module)
+            if s is not None:
+                if head in s.imports and \
+                        s.imports[head] not in self.linker.by_module:
+                    return []
+                if head in s.from_imports:
+                    mod, orig = s.from_imports[head]
+                    if f"{mod}.{orig}" not in self.linker.by_module \
+                            and mod not in self.linker.by_module:
+                        return []   # `from jax import lax` → lax.scan
+        return self.linker.resolve_call(desc, f)
+
+    def _resolve_fn_arg(self, expr, ctx: _Func) -> list[_Func]:
+        if isinstance(expr, ast.Lambda):
+            return []           # no body scope to analyze; churn rules
+            # judge the lambda at its construction site instead
+        d = _dotted(expr)
+        if not d:
+            return []
+        return self.resolve(("call", d), ctx)
+
+    def _mark(self, f: _Func, kind, file, line, via=None):
+        if f.key in self.traced:
+            return False
+        self.traced[f.key] = TracedFn(f.key, kind, file, line, via=via)
+        return True
+
+    def discover(self):
+        for scan in self.linker.scans:
+            mod_ctx = self._module_ctx(scan)
+            # decorator roots
+            for fq, node, _cls in self._scan_scopes[id(scan)]:
+                f = scan.funcs.get(fq)
+                if f is None:
+                    continue
+                for dec in node.decorator_list:
+                    call = dec if isinstance(dec, ast.Call) else None
+                    d = _dotted(call.func if call else dec)
+                    if d in _JIT_DOTTED:
+                        self._mark(f, "jit", scan.rel, dec.lineno)
+                    elif call is not None and \
+                            _call_tail(d) == "partial" and call.args and \
+                            _dotted(call.args[0]) in _JIT_DOTTED:
+                        if self._mark(f, "jit", scan.rel, dec.lineno):
+                            self.traced[f.key].static_params |= \
+                                _static_params_of(call, node)
+            # call-site roots
+            for node in ast.walk(scan.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                tail = _call_tail(d)
+                kind = None
+                fn_expr = None
+                if d in _JIT_DOTTED and node.args:
+                    kind, fn_expr = "jit", node.args[0]
+                elif tail == "scan" and "lax" in d and node.args:
+                    kind, fn_expr = "scan", node.args[0]
+                elif tail == "_fused_wrapper" and node.args:
+                    kind, fn_expr = "fused", node.args[0]
+                elif tail == "wrap" and isinstance(node.func,
+                                                   ast.Attribute) \
+                        and node.args:
+                    kind, fn_expr = "wrap", node.args[0]
+                elif node.args and any(
+                        kw.arg == "device_fn" and _truthy_const(kw.value)
+                        for kw in node.keywords):
+                    kind, fn_expr = "device_fn", node.args[0]
+                if kind is None:
+                    continue
+                ctx = self._ctx_for(scan, node)
+                for g in self._resolve_fn_arg(fn_expr, ctx):
+                    fresh = self._mark(g, kind, scan.rel, node.lineno)
+                    if fresh and kind == "jit":
+                        gnode = self.scopes.get(g.key)
+                        self.traced[g.key].static_params |= \
+                            _static_params_of(
+                                node, gnode.node if gnode else None)
+        self._propagate()
+
+    def _ctx_for(self, scan, node) -> _Func:
+        """The innermost function enclosing ``node`` (for name
+        resolution), else a module-level pseudo context."""
+        best = None
+        for fq, fnode, _cls in self._scan_scopes[id(scan)]:
+            if fnode.lineno <= node.lineno <= (fnode.end_lineno or
+                                               fnode.lineno):
+                if best is None or fnode.lineno >= best[1].lineno:
+                    best = (fq, fnode)
+        if best is not None:
+            f = scan.funcs.get(best[0])
+            if f is not None:
+                return f
+        return self._module_ctx(scan)
+
+    def _propagate(self):
+        """Transitive closure: whatever a traced fn calls is traced."""
+        work = list(self.traced)
+        while work:
+            key = work.pop()
+            f = self.linker.funcs.get(key)
+            if f is None:
+                continue
+            for desc, line, _held in f.calls:
+                for g in self.resolve(desc, f):
+                    if g.key not in self.traced:
+                        self.traced[g.key] = TracedFn(
+                            g.key, "call", f.file, line, via=f.qual)
+                        work.append(g.key)
+
+
+# -- phase 2: the rules -------------------------------------------------
+
+class _RuleRunner:
+    def __init__(self, tl: _TraceLinker, emitter: _Emitter):
+        self.tl = tl
+        self.emitter = emitter
+
+    def run(self):
+        for key, why in sorted(self.tl.traced.items()):
+            scope = self.tl.scopes.get(key)
+            if scope is None:
+                continue
+            self._check_traced_fn(scope, why)
+        # donation-reuse and jit-cache-churn judge HOST code (the
+        # scopes that BUILD and CALL the programs), so every function
+        # is checked, traced or not — plus one pseudo-scope per MODULE
+        # body: a script-level warmup loop is the canonical churn
+        # pattern, and the doctor's remediation pointer must not
+        # dead-end on it
+        module_scopes = [
+            _FnScope(f"{scan.module}:<module>", scan.tree, scan.rel,
+                     scan.module, "<module>", None)
+            for scan in self.tl.linker.scans]
+        for scope in sorted(list(self.tl.scopes.values()) +
+                            module_scopes,
+                            key=lambda s: (s.file, s.qual)):
+            self._check_donation(scope)
+            self._check_churn(scope)
+
+    # -- traced-value dataflow ----------------------------------------
+    def _traced_names(self, scope: _FnScope, why: TracedFn) -> set:
+        node = scope.node
+        traced: set = set()
+        if why.kind != "call":
+            # parameters seed the traced set only for ROOT traced fns
+            # — a jit/scan/wrap entry's arguments really are tracers.
+            # A transitively-traced helper's params are unknowable
+            # (name-based may-analysis would brand every static string
+            # /int argument a tracer and flood traced-branch); inside
+            # it, values born from jnp./lax. calls still count.
+            args = node.args
+            traced = {a.arg for a in (args.posonlyargs + args.args +
+                                      args.kwonlyargs)}
+            for va in (args.vararg, args.kwarg):
+                if va is not None:
+                    traced.add(va.arg)
+            traced -= {"self", "cls"}
+            traced -= why.static_params
+        # iterate to a FIXPOINT: the walk yields nodes out of source
+        # order, so a bounded pass count would silently drop any
+        # assignment chain deeper than the pass count — exactly the
+        # a0 = jnp.f(x); a1 = a0 + 1; a2 = a1 * 2 shape numeric code
+        # is made of
+        changed = True
+        while changed:
+            changed = False
+            for stmt in self._own_nodes(node):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                    value = stmt.value
+                    if value is None:
+                        continue
+                    if self._dynamic_refs(value, traced) or \
+                            self._has_device_call(value):
+                        targets = (stmt.targets
+                                   if isinstance(stmt, ast.Assign)
+                                   else [stmt.target])
+                        for t in targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name) and \
+                                        n.id not in traced:
+                                    traced.add(n.id)
+                                    changed = True
+        return traced
+
+    @staticmethod
+    def _own_nodes(fnode):
+        """Walk a function body WITHOUT descending into nested defs —
+        a nested def is its own traced scope (reached via the closure)
+        and must not double-report under its parent."""
+        stack = list(ast.iter_child_nodes(fnode))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    @staticmethod
+    def _has_device_call(expr) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                head = d.split(".", 1)[0]
+                if head in ("jnp", "lax", "jax"):
+                    return True
+        return False
+
+    @staticmethod
+    def _static_ctx(node) -> bool:
+        """Is ``node`` a static-under-trace/donation context (shape
+        dispatch, metadata access, identity comparison)? THE shared
+        predicate for every exemption walker — one list to extend."""
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d in _STATIC_CALLS or _call_tail(d) in _STATIC_CALLS:
+                return True
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return True
+        return False
+
+    def _dynamic_refs(self, expr, traced) -> list:
+        """Traced Name loads used DYNAMICALLY in ``expr`` — references
+        through static-under-trace accessors (.shape/.ndim/len()/
+        isinstance/is-None) are pruned."""
+        out: list = []
+        if self._static_ctx(expr):
+            return out
+        if isinstance(expr, ast.Name) and expr.id in traced and \
+                isinstance(expr.ctx, ast.Load):
+            return [expr]
+        for child in ast.iter_child_nodes(expr):
+            out.extend(self._dynamic_refs(child, traced))
+        return out
+
+    # -- rules on traced functions ------------------------------------
+    def _check_traced_fn(self, scope: _FnScope, why: TracedFn):
+        traced = self._traced_names(scope, why)
+        where = (f"traced via {why.kind} at {why.file}:{why.line}"
+                 + (f" (through {why.via})" if why.via else ""))
+        sites_tail = [(scope.file, scope.node.lineno),
+                      (why.file, why.line)]
+        for n in self._own_nodes(scope.node):
+            if isinstance(n, ast.Call):
+                self._check_effect_call(n, scope, where, sites_tail)
+                self._check_host_op(n, traced, scope, where, sites_tail)
+            elif isinstance(n, ast.Subscript) and \
+                    _dotted(n.value) == "os.environ" and \
+                    isinstance(n.ctx, ast.Load):
+                self.emitter.emit(
+                    "trace-time-effect",
+                    f"os.environ read inside traced "
+                    f"{scope.qual!r} ({where}) — the env is read ONCE "
+                    f"at trace time, not per step",
+                    [(scope.file, n.lineno)] + sites_tail)
+            elif isinstance(n, (ast.If, ast.While)):
+                refs = self._dynamic_refs(n.test, traced)
+                if refs:
+                    names = sorted({r.id for r in refs})
+                    kind = "while" if isinstance(n, ast.While) else "if"
+                    self.emitter.emit(
+                        "traced-branch",
+                        f"Python {kind} on traced value(s) "
+                        f"{names} inside {scope.qual!r} ({where}) — "
+                        f"concretizes the tracer",
+                        [(scope.file, n.lineno)] + sites_tail)
+
+    def _check_effect_call(self, call, scope, where, sites_tail):
+        d = _dotted(call.func)
+        tail = _call_tail(d)
+        effect = None
+        if tail in ("counter", "gauge", "histogram") and call.args:
+            effect = f"obs {tail}()"
+        elif tail.startswith("record_"):
+            effect = f"flight breadcrumb {tail}()"
+        elif d == "os.getenv" or d.startswith("os.environ"):
+            effect = f"env read {d}()"
+        elif d == "print":
+            effect = "print()"
+        elif tail in _LOG_TAILS and self._logger_receiver(d):
+            effect = f"logging call {d}()"
+        if effect is None:
+            return
+        self.emitter.emit(
+            "trace-time-effect",
+            f"{effect} inside traced {scope.qual!r} ({where}) — "
+            f"executes once at trace time, then never again per step",
+            [(scope.file, call.lineno)] + sites_tail)
+
+    @staticmethod
+    def _logger_receiver(d: str) -> bool:
+        """Does the dotted receiver look like a LOGGER (logging.info,
+        log.warning, self._logger.error), not any object whose name
+        merely contains 'log' (catalog.error, dialog.warning)?"""
+        if d.startswith("logging."):
+            return True
+        head = d.rsplit(".", 1)[0].rsplit(".", 1)[-1].lower()
+        return head in ("log", "logger") or head.endswith("_log") or \
+            head.endswith("logger")
+
+    def _check_host_op(self, call, traced, scope, where, sites_tail):
+        d = _dotted(call.func)
+        tail = _call_tail(d)
+        bad = None
+        if (d.startswith("np.") or d.startswith("numpy.")) and any(
+                self._dynamic_refs(a, traced)
+                for a in list(call.args)
+                + [kw.value for kw in call.keywords]):
+            bad = f"{d}(...)"
+        elif tail == "item" and not call.args and not call.keywords and \
+                isinstance(call.func, ast.Attribute) and \
+                self._dynamic_refs(call.func.value, traced):
+            bad = ".item()"
+        elif isinstance(call.func, ast.Name) and \
+                call.func.id in ("float", "int", "bool") and \
+                len(call.args) == 1 and \
+                self._dynamic_refs(call.args[0], traced):
+            bad = f"{call.func.id}(...)"
+        if bad is None:
+            return
+        self.emitter.emit(
+            "host-op-on-traced",
+            f"{bad} applied to a traced value inside {scope.qual!r} "
+            f"({where}) — host coercion under trace",
+            [(scope.file, call.lineno)] + sites_tail)
+
+    # -- rules on program-building host code ---------------------------
+    def _check_donation(self, scope: _FnScope):
+        node = scope.node
+        makers: dict[str, object] = {}   # bound name -> positions|'all'
+        donated: list = []               # (name, call_line, call_end)
+        # pass 1: donating-maker bindings (the walk is not in source
+        # order, so makers must be complete before calls are judged)
+        for n in self._own_nodes(node):
+            if isinstance(n, (ast.Assign, ast.AnnAssign)) and \
+                    isinstance(n.value, ast.Call):
+                pos = _donate_positions(n.value)
+                if pos is not None:
+                    for t in _bind_targets(n):
+                        if isinstance(t, ast.Name):
+                            makers[t.id] = pos
+        # pass 2: calls through a maker donate their positional args
+        for n in self._own_nodes(node):
+            if not isinstance(n, ast.Call):
+                continue
+            pos = None
+            if isinstance(n.func, ast.Name) and n.func.id in makers:
+                pos = makers[n.func.id]
+            elif isinstance(n.func, ast.Call):
+                pos = _donate_positions(n.func)   # maker()(args) form
+            if pos is None:
+                continue
+            for i, a in enumerate(n.args):
+                if pos != "all" and i not in pos:
+                    continue
+                if isinstance(a, ast.Name):
+                    donated.append((a.id, n.lineno,
+                                    n.end_lineno or n.lineno))
+        if not donated:
+            return
+        names = {name for name, _l, _e in donated}
+        # loads through static-under-donation accessors (.shape/.ndim/
+        # len()/isinstance) are METADATA reads — legal on a donated
+        # array (only data access dies), pruned like the traced-value
+        # rules prune them
+        loads = self._dyn_load_lines(node, names)
+        stores: dict[str, list] = {}
+        for n in self._own_nodes(node):
+            if isinstance(n, ast.Name) and not isinstance(n.ctx,
+                                                          ast.Load):
+                stores.setdefault(n.id, []).append(n.lineno)
+        for name, call_line, call_end in donated:
+            for use in sorted(loads.get(name, [])):
+                if use <= call_end:
+                    # inside the (possibly multi-line) donating call
+                    # itself: that load IS the donation, not a reuse
+                    continue
+                # the call line counts as a rebind site (the canonical
+                # donate-and-rebind idiom `x = g(x)` stores g's RESULT
+                # into x) — but a store ON the use line does not: in
+                # `x = x + 1` the RHS reads the dead buffer BEFORE the
+                # rebind lands
+                st = [s for s in stores.get(name, [])
+                      if call_line <= s < use]
+                if st:
+                    break   # rebound before the use: later uses see
+                    # the NEW binding, not the donated buffer. (A loop
+                    # target's own store sits at the FOR line, before
+                    # call_line — it never exempts a same-iteration
+                    # read of the dead buffer, which executes before
+                    # the next rebind.)
+                self.emitter.emit(
+                    "donation-reuse",
+                    f"{name!r} donated to a jitted program at line "
+                    f"{call_line} and read again at line {use} in "
+                    f"{scope.qual!r} — the donated buffer is dead "
+                    f"after dispatch",
+                    [(scope.file, use), (scope.file, call_line),
+                     (scope.file, getattr(scope.node, "lineno", 1))])
+                break       # one finding per donated name
+
+    def _check_churn(self, scope: _FnScope):
+        node = scope.node
+        # names whose jit-result flows into a subscript store = the
+        # retention pattern (per_fn[key] = fused / self._jits[k] = fn)
+        cached_names: set = set()
+        for n in self._own_nodes(node):
+            if isinstance(n, ast.Assign):
+                has_sub = any(isinstance(t, ast.Subscript)
+                              for t in n.targets)
+                if has_sub:
+                    if isinstance(n.value, ast.Name):
+                        cached_names.add(n.value.id)
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and (
+                            has_sub or self._is_setdefault(n.value)):
+                        cached_names.add(t.id)
+        decorated_cached = any(
+            _call_tail(_dotted(d.func if isinstance(d, ast.Call) else d))
+            in ("lru_cache", "cache")
+            for d in getattr(node, "decorator_list", []))
+        # a jit result that ESCAPES to the caller (returned directly,
+        # or via its bound name) is the factory pattern — the caller
+        # owns retention (make_train_step and friends), not churn
+        returned_names: set = set()
+        returned_calls: set = set()
+        for n in self._own_nodes(node):
+            if isinstance(n, ast.Return) and n.value is not None:
+                if isinstance(n.value, ast.Name):
+                    returned_names.add(n.value.id)
+                elif isinstance(n.value, ast.Call):
+                    returned_calls.add(id(n.value))
+        local_defs = {c.name for c in ast.iter_child_nodes(node)
+                      if isinstance(c, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        loops = [n for n in self._own_nodes(node)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        jit_bound: dict[str, ast.Call] = {}
+        for n in self._own_nodes(node):
+            if isinstance(n, (ast.Assign, ast.AnnAssign)) and \
+                    isinstance(n.value, ast.Call):
+                d = _dotted(n.value.func)
+                if d in _JIT_DOTTED or _call_tail(d) in (
+                        "_fused_wrapper", "wrap"):
+                    for t in _bind_targets(n):
+                        if isinstance(t, ast.Name):
+                            jit_bound[t.id] = n.value
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func)
+            tail = _call_tail(d)
+            is_jit = d in _JIT_DOTTED
+            is_wrap = tail == "_fused_wrapper" or (
+                tail == "wrap" and isinstance(n.func, ast.Attribute))
+            if not is_jit and not is_wrap:
+                continue
+            if decorated_cached:
+                continue
+            bound = self._bound_name(node, n)
+            if bound in cached_names or self._stored_in_subscript(node, n):
+                continue
+            if id(n) in returned_calls or (bound is not None and
+                                           bound in returned_names):
+                continue
+            fn_arg = n.args[0] if n.args else None
+            fresh_identity = isinstance(fn_arg, ast.Lambda) or (
+                isinstance(fn_arg, ast.Name) and fn_arg.id in local_defs)
+            in_loop = any(lp.lineno <= n.lineno <=
+                          (lp.end_lineno or lp.lineno) for lp in loops)
+            if scope.func is None and not in_loop:
+                # module pseudo-scope: the body runs ONCE per process,
+                # so `jfn = jax.jit(module_def)` — the canonical hoist
+                # the rule's own hint prescribes — is a stable
+                # identity, never a per-call closure; only loops churn
+                # at module level
+                continue
+            if is_wrap:
+                # the house wrappers RETAIN on fn identity
+                # (fn._tpudl_fused[key] / fn._tpudl_codec_wrap[key]):
+                # calling them in a loop over a STABLE fn is the
+                # pattern working; only a fresh lambda/local-def per
+                # call defeats it
+                if fresh_identity:
+                    self.emitter.emit(
+                        "jit-cache-churn",
+                        f"{d}(...) over a per-call fn identity in "
+                        f"{scope.qual!r} — the wrapper caches on the "
+                        f"fn object, and a fresh lambda/closure per "
+                        f"call means a fresh cache (and a retrace) "
+                        f"every time",
+                        [(scope.file, n.lineno),
+                         (scope.file, getattr(scope.node, "lineno", 1))])
+                continue
+            if in_loop:
+                self.emitter.emit(
+                    "jit-cache-churn",
+                    f"{d or 'jit'}(...) built inside a loop in "
+                    f"{scope.qual!r} — a fresh program per iteration, "
+                    f"every one a retrace",
+                    [(scope.file, n.lineno),
+                     (scope.file, getattr(scope.node, "lineno", 1))])
+                continue
+            if fresh_identity:
+                self.emitter.emit(
+                    "jit-cache-churn",
+                    f"{d}(...) over a per-call closure in "
+                    f"{scope.qual!r} — each invocation builds a "
+                    f"fresh fn identity, so the jit cache never "
+                    f"hits (the _fused_wrapper retention pattern "
+                    f"caches the wrapper on the fn)",
+                    [(scope.file, n.lineno),
+                     (scope.file, getattr(scope.node, "lineno", 1))])
+        # unhashable static args: g = jit(f, static_argnums=...) then
+        # g(..., [literal], ...) at a static position
+        for n in self._own_nodes(node):
+            if not isinstance(n, ast.Call) or not isinstance(
+                    n.func, ast.Name):
+                continue
+            maker = jit_bound.get(n.func.id)
+            if maker is None:
+                continue
+            static_pos = _static_argnum_positions(maker)
+            for i, a in enumerate(n.args):
+                if i in static_pos and isinstance(
+                        a, (ast.List, ast.Dict, ast.Set)):
+                    self.emitter.emit(
+                        "jit-cache-churn",
+                        f"unhashable {type(a).__name__.lower()} "
+                        f"literal passed at static position {i} of a "
+                        f"jitted call in {scope.qual!r} — static args "
+                        f"must hash (use a tuple)",
+                        [(scope.file, n.lineno),
+                         (scope.file, maker.lineno)])
+
+    def _dyn_load_lines(self, root, names: set) -> dict:
+        """name -> [lineno] of DYNAMIC loads (data access) of
+        ``names`` in this scope: nested defs are their own scope, and
+        static-metadata contexts (_STATIC_ATTRS/_STATIC_CALLS/is-None)
+        are pruned."""
+        out: dict = {}
+
+        def walk(n):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return
+            if self._static_ctx(n):
+                return
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load) and n.id in names:
+                out.setdefault(n.id, []).append(n.lineno)
+            if isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Name) and \
+                    n.target.id in names:
+                # `x += 1` READS the pre-assignment value even though
+                # the target's ctx is Store — on a donated buffer
+                # that read is the dead-buffer bug
+                out.setdefault(n.target.id, []).append(n.lineno)
+            for c in ast.iter_child_nodes(n):
+                walk(c)
+
+        for c in ast.iter_child_nodes(root):
+            walk(c)
+        return out
+
+    @staticmethod
+    def _is_setdefault(value) -> bool:
+        return isinstance(value, ast.Call) and \
+            _call_tail(_dotted(value.func)) == "setdefault"
+
+    @staticmethod
+    def _bound_name(fnode, call) -> str | None:
+        for n in ast.walk(fnode):
+            if isinstance(n, (ast.Assign, ast.AnnAssign)) and \
+                    n.value is call:
+                for t in _bind_targets(n):
+                    if isinstance(t, ast.Name):
+                        return t.id
+        return None
+
+    @staticmethod
+    def _stored_in_subscript(fnode, call) -> bool:
+        for n in ast.walk(fnode):
+            if isinstance(n, ast.Assign) and n.value is call and any(
+                    isinstance(t, ast.Subscript) for t in n.targets):
+                return True
+            if isinstance(n, ast.Call) and call in n.args and \
+                    _call_tail(_dotted(n.func)) == "setdefault":
+                return True
+        return False
+
+
+# -- public API --------------------------------------------------------
+
+def traced_functions(sources: dict, modules: dict | None = None
+                     ) -> dict[str, TracedFn]:
+    """The traced set itself (no findings): what the tests assert
+    against and ``--json`` consumers can inspect."""
+    linker, _supp, _errors = _link(sources, modules)
+    tl = _TraceLinker(linker)
+    tl.discover()
+    return tl.traced
+
+
+def analyze_sources(sources: dict, rules=None,
+                    modules: dict | None = None,
+                    supp_sink: dict | None = None,
+                    linked=None) -> list[Finding]:
+    """Run the trace rules over in-memory sources (``{relpath: src}``)
+    — the fixture entry point and the CLI's shared-source path.
+    ``linked`` (from :func:`concurrency.link_sources`) reuses one
+    parse across the interprocedural halves."""
+    linker, suppressions, _errors = (linked if linked is not None
+                                     else _link(sources, modules))
+    tl = _TraceLinker(linker)
+    tl.discover()
+    emitter = _Emitter(suppressions,
+                       set(rules) if rules is not None else None)
+    _RuleRunner(tl, emitter).run()
+    if supp_sink is not None:
+        supp_sink.update(suppressions)
+    emitter.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return emitter.findings
+
+
+def analyze(paths, root: str = ".", rules=None
+            ) -> tuple[list[Finding], list[str]]:
+    """Run the trace rules over files/dirs — (findings, errors), the
+    ``check_paths`` contract: unreadable AND unparseable files are
+    errors (an unparseable file must never read as a clean one)."""
+    sources, modules, errors = read_sources(paths, root=root)
+    linked = _link(sources, modules)
+    errors.extend(e for e in linked[2] if e not in errors)
+    findings = analyze_sources(sources, rules=rules, modules=modules,
+                               linked=linked)
+    return findings, errors

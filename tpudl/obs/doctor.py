@@ -15,6 +15,12 @@ gang), merge per-host evidence and CLASSIFY the failure:
 - ``decode_error_storm`` — decode/corruption errors are a large
   fraction of reads (``imageio.decode_errors``, ``data.cache.corrupt``
   and the error ring agree): the data went bad, not the code;
+- ``recompile_storm`` — the traceck sentinel (``TPUDL_TRACECK=1``,
+  tpudl.testing.traceck) flagged a fn identity retracing past its
+  threshold (``traceck.storms`` and the error ring agree): the run
+  was recompiling instead of computing — ranked beside (and checked
+  before) ``dispatch_slowdown``, because a storm IS the usual cause
+  of a slow dispatch that nobody can explain;
 - ``dispatch_slowdown`` — a stall (or dominant stage share) in
   ``dispatch``: the device/backend stopped answering or slowed;
 - ``clean_external_kill`` — a SIGTERM/SIGQUIT dump with no stall and
@@ -273,6 +279,41 @@ def classify(merged: dict) -> dict:
             "the error ring"))
         return {"classification": "decode_error_storm",
                 "suspect_stage": "decode",
+                "suspect_host": suspect_host,
+                "evidence": evidence, "stage_rates": rates}
+
+    # 2b. recompile storm: the traceck sentinel measured a fn identity
+    #     retracing past TPUDL_TRACECK_STORM. Checked BEFORE the stall
+    #     rules — a retrace pins the host in compilation for ~60 s per
+    #     program, which reads as a dispatch stall/slowdown from
+    #     outside; the storm is the cause, not the symptom
+    storms = sum(_metric_value(d, "traceck.storms")
+                 for d in hosts.values())
+    storm_ring = [e for e in errors
+                  if str(e.get("kind", "")).startswith("traceck")]
+    if storms or storm_ring:
+        retraces = sum(_metric_value(d, "traceck.retraces")
+                       for d in hosts.values())
+        evidence.insert(0, (
+            f"{storms:.0f} recompile storm(s) flagged by the traceck "
+            f"sentinel ({retraces:.0f} retraces total); each retrace "
+            f"recompiles (~60 s on the real chip)"))
+        for e in storm_ring[-3:]:
+            evidence.append(
+                f"storm: {e.get('fn', '?')} traced "
+                f"{e.get('traces', '?')} times")
+        if stalls:
+            last = stalls[-1]
+            evidence.append(
+                f"history: watchdog flagged {len(stalls)} stall(s); "
+                f"last: {last.get('name')} frozen {last.get('age_s')}s "
+                f"in stage {_stall_stage(last) or 'unknown'!r}")
+        evidence.append("fix the churn site (the static "
+                        "jit-cache-churn rule names it: python -m "
+                        "tools.tpudl_check --rules jit-cache-churn "
+                        "<paths>)")
+        return {"classification": "recompile_storm",
+                "suspect_stage": "dispatch",
                 "suspect_host": suspect_host,
                 "evidence": evidence, "stage_rates": rates}
 

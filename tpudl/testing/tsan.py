@@ -568,8 +568,9 @@ def write_report(path: str | None = None) -> str | None:
         os.replace(tmp, out)
         return out
     except Exception:
-        # tpudl: ignore[swallowed-except] — exit-path best effort: a
-        # failed report write must not turn a clean exit into a crash
+        # exit-path best effort: a failed report write must not turn a
+        # clean exit into a crash (the unlink attempt below is the
+        # breadcrumb-free cleanup the rule accepts)
         try:
             os.unlink(tmp)
         except OSError:

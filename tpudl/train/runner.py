@@ -23,6 +23,7 @@ from the last checkpoint.
 
 from __future__ import annotations
 
+import functools
 import logging
 import time
 
@@ -86,6 +87,16 @@ def _restart_backoff_base_s() -> float:
                                     "") or 0.1)
     except ValueError:
         return 0.1
+
+
+@functools.lru_cache(maxsize=1)
+def _owning_identity():
+    """The ONE cached jitted identity program ``Trainer.fit``'s
+    ``_own`` runs to take ownership of an already-mesh-sharded tree
+    without a host gather. A fresh ``jax.jit(lambda t: t)`` at the
+    call site would be a fresh fn identity — a retrace per fit
+    (jit-cache-churn); jit's own cache then keys per tree structure."""
+    return jax.jit(lambda t: t)
 
 
 class TrainContext:
@@ -280,7 +291,7 @@ class Trainer:
 
         def _own(tree):
             if all(_spans_mesh(leaf) for leaf in jax.tree.leaves(tree)):
-                return jax.jit(lambda t: t)(tree)
+                return _owning_identity()(tree)
             return jax.tree.map(np.asarray, tree)
 
         params = _own(params)

@@ -46,6 +46,9 @@ def registerKerasImageUDF(udf_name: str, keras_model_or_file,
             y = y[0]
         return y.reshape(y.shape[0], -1)
 
+    # tpudl: ignore[jit-cache-churn] — UDF registration runs once per
+    # name; the registered frame_fn closure retains jfn, so the one
+    # trace here is the program's lifetime cost
     jfn = jax.jit(fused)
 
     def frame_fn(frame):

@@ -110,6 +110,9 @@ def makeGraphUDF(graph, udf_name: str, fetches=None,
             y = y[0]
         return y
 
+    # tpudl: ignore[jit-cache-churn] — makeGraphUDF runs once per
+    # registered UDF; the returned frame_fn closure retains jfn, so
+    # the one trace here is the program's lifetime cost
     jfn = jax.jit(first_fetch)
 
     def frame_fn(frame):

@@ -116,6 +116,9 @@ class NamedModel:
                           include_top=include_top, classes=self.classes)
             return s.params
 
+        # tpudl: ignore[jit-cache-churn] — params init is a deliberate
+        # one-shot program (once per model build); retaining it would
+        # pin a throwaway init graph for the process lifetime
         return jax.jit(_init)(rng)
 
     # -- pure apply fns (jit at call sites) ------------------------------
