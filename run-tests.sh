@@ -26,6 +26,13 @@ echo "== traceguard subset (jit-boundary rules + traceck sentinel) =="
 # subprocesses the tests spawn themselves, so no env is set here.
 python -m pytest tests/test_traceguard.py -q "$@"
 
+echo "== chaos subset (fault-containment matrix, ISSUE 14 acceptance) =="
+# Target the supervisor module DIRECTLY (same rationale as the armed
+# concurrency subset above: an unrelated jax-version collection error
+# exits pytest 1 under set -e). User args go FIRST so a caller's -m
+# cannot replace the chaos marker and skip the matrix.
+python -m pytest tests/test_supervisor.py -q "$@" -m chaos
+
 echo "== virtual-mesh executor subset (ISSUE 11 acceptance) =="
 # Target the mesh-executor module DIRECTLY (same rationale as the
 # armed concurrency subset above): a jax-version collection error in

@@ -302,6 +302,38 @@ class TestWatchdog:
         assert wd.scan() == []  # deregistered on exit
         assert obs_watchdog.get_registry().describe() == {}
 
+    def test_supervised_retry_is_not_double_flagged_as_stall(
+            self, forensics, monkeypatch):
+        """ISSUE 14 satellite: a stage the fault-containment supervisor
+        is actively retrying must not be flagged as a stall — each
+        retry attempt registers a FRESH executor heartbeat, and the
+        supervisor's own heartbeat is beaten through every rung and
+        every backoff slice, so even a retry pause longer than
+        TPUDL_WATCHDOG_STALL_S stays un-flagged while a genuinely hung
+        run still would be."""
+        from tpudl.frame import Frame
+        from tpudl.testing import faults
+
+        # retry backoff (0.3s) deliberately LONGER than the stall
+        # threshold (0.12s): without the re-arm this is a guaranteed
+        # false stall
+        monkeypatch.setenv("TPUDL_RETRY_IO_BACKOFF_S", "0.3")
+        obs_watchdog.start_watchdog(stall_s=0.12, interval=0.04)
+        frame = Frame({"x": np.arange(64, dtype=np.float32)})
+        plan = faults.FaultPlan(
+            [{"point": "frame.prepare", "action": "raise",
+              "exc": "OSError", "first_calls": 1}])
+        with plan.armed():
+            out = frame.map_batches(lambda b: b * 2, ["x"], ["y"],
+                                    batch_size=16, supervise=True)
+        assert np.array_equal(np.asarray(out["y"]),
+                              np.arange(64, dtype=np.float32) * 2)
+        assert plan.fired, "the retry path must actually have run"
+        time.sleep(0.1)  # let a final scan pass over the (empty) set
+        assert "obs.watchdog.stalls" not in obs.snapshot(), (
+            "a supervised retry was double-flagged as a stall")
+        assert forensics.snapshot()["stalls"] == []
+
     def test_daemon_thread_detects_stall(self, forensics):
         obs_watchdog.start_watchdog(stall_s=0.1, interval=0.03)
         with obs_watchdog.heartbeat("daemon.victim", stage="h2d"):

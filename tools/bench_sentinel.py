@@ -155,6 +155,17 @@ METRICS = [
            keys=[("device_cache", "hbm_warm_speedup")],
            tail_patterns=[r'"hbm_warm_speedup": ' + _NUM],
            wire_sensitive=False, floor=0.30),
+    # fault-recovery: a within-round ratio (clean wall over
+    # recovered-from-one-injected-fault wall, same program/rows — the
+    # higher-is-better twin of degraded_recovery_overhead_pct on the
+    # judged line) — scored raw like async_speedup. A drop is recovery
+    # getting more expensive (extra attempts, a deeper rung than the
+    # fault needs, lost warm state across the retry) — a supervisor
+    # regression, never weather
+    Metric("fault_recovery_efficiency",
+           keys=[("fault_recovery", "fault_recovery_efficiency")],
+           tail_patterns=[r'"fault_recovery_efficiency": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
     # mesh-scaling: a within-round ratio (sharded executor over the
     # single-chip fast path on the virtual 8-device CPU mesh, same
     # program/rows) — no wire, no tunnel; scored raw like

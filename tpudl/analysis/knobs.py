@@ -55,6 +55,13 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_FRAME_AUTOTUNE", "bool", "1", "frame",
          "seed unset fuse_steps/dispatch_depth/prefetch_depth from the "
          "roofline advisor's recommendations (0 = off)"),
+    Knob("TPUDL_FRAME_DEGRADE", "bool", "0", "frame",
+         "1 arms the fault-containment supervisor (FAULTS.md): "
+         "classified executor faults retry the run down the bounded "
+         "degradation ladder instead of dying"),
+    Knob("TPUDL_FRAME_DEGRADE_MAX_RUNGS", "int", "6", "frame",
+         "degradation rungs the supervisor may apply before raising "
+         "the typed error with a flight dump"),
     Knob("TPUDL_MESH_FAST_PATH", "bool", "1", "frame",
          "0 reverts the mesh executor to the conservative pre-ISSUE-11 "
          "path (serial blocking dispatch, blocking transfer barrier, "
@@ -216,6 +223,9 @@ KNOBS: tuple[Knob, ...] = (
          "resident)"),
     Knob("TPUDL_BENCH_DATA_FILES", "int", "192", "bench",
          "data-pipeline cache sub-bench file count"),
+    Knob("TPUDL_BENCH_FAULT_N", "int", "512", "bench",
+         "fault-recovery sub-bench row count (clean vs "
+         "injected-fault+recovery arms)"),
     Knob("TPUDL_BENCH_ASYNC_N", "int", "768", "bench",
          "async-dispatch A/B sub-bench row count"),
     Knob("TPUDL_BENCH_ASYNC_DEPTH", "int", "4", "bench",

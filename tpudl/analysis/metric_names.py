@@ -59,6 +59,15 @@ METRICS: tuple[Metric, ...] = (
     Metric("frame.dispatch.overlap_s", "gauge",
            "dispatch seconds the in-flight window hid from the "
            "consumer (last async run)"),
+    Metric("frame.degraded.rungs", "counter",
+           "degradation-ladder rungs applied by the fault-containment "
+           "supervisor (FAULTS.md)"),
+    Metric("frame.degraded.recovered_batches", "counter",
+           "batches completed by runs that survived on a degraded "
+           "rung"),
+    Metric("frame.degraded.exhausted", "counter",
+           "supervised runs whose ladder ran out (typed error + flight "
+           "dump)"),
     Metric("frame.mesh.pad_rows", "gauge",
            "rows of SPMD batch padding the last mesh run shipped and "
            "discarded"),
@@ -110,6 +119,9 @@ METRICS: tuple[Metric, ...] = (
     Metric("data.hbm.bytes_served", "counter",
            "bytes served from HBM instead of the wire (the roofline "
            "subtracts these from its wire attribution)"),
+    Metric("data.hbm.put_failed", "counter",
+           "batches that failed to become resident mid-placement "
+           "(tallies stayed consistent; fell back to the wire)"),
     Metric("data.hbm.donation_blocked", "counter",
            "resident batches routed away from a donating program "
            "(resident buffers are never donated)"),

@@ -58,6 +58,7 @@ from tpudl.testing import tsan as _tsan
 __all__ = ["DeviceBatchCache", "Pin", "get_device_cache",
            "reset_device_cache", "run_key", "budget_bytes",
            "bulk_resident", "array_token", "count_donation_blocked",
+           "count_put_failed",
            "DEFAULT_BUDGET_BYTES", "DEFAULT_BUDGET_FRACTION"]
 
 # when the backend reports no memory figure (CPU simulation, exotic
@@ -356,7 +357,16 @@ class DeviceBatchCache:
           (stale entries of a previous dataset) still evicts."""
         from tpudl.obs import metrics as _m
 
-        entry = _Entry(key, arrays, n_pad, codecs)
+        try:
+            entry = _Entry(key, arrays, n_pad, codecs)
+        # a batch whose arrays cannot even describe themselves (a
+        # device_put that failed mid-placement leaves buffers whose
+        # metadata probes raise) must not become resident OR touch the
+        # byte tallies: counted, and the batch stays a plain wire
+        # transfer
+        except Exception:
+            count_put_failed()
+            return None
         run = entry.run
         evicted = 0
         stored = dedup = False
@@ -389,14 +399,23 @@ class DeviceBatchCache:
                     self._pinned_bytes += entry.nbytes
                     stored = True
             resident = self._bytes
-        if evicted:
-            _m.counter("data.hbm.evictions").inc(evicted)
-        _m.gauge("data.hbm.bytes_resident").set(resident)
-        if not stored:
-            return None
-        if not dedup:
-            _m.counter("data.hbm.puts").inc()
-        return Pin(self, entry)
+        # the Pin exists BEFORE any metric publication: once the entry
+        # is stored+pinned under the lock, nothing between here and the
+        # return may raise, or the pin would strand in the tallies
+        # forever (bytes pinned that no caller can ever release)
+        pin = Pin(self, entry) if stored else None
+        try:
+            if evicted:
+                _m.counter("data.hbm.evictions").inc(evicted)
+            _m.gauge("data.hbm.bytes_resident").set(resident)
+            if stored and not dedup:
+                _m.counter("data.hbm.puts").inc()
+        # tpudl: ignore[swallowed-except] — the observer must never
+        # strand a pinned entry: accounting consistency beats a lost
+        # metric tick
+        except Exception:
+            pass
+        return pin
 
     def _evictable_locked(self, incoming_run):
         """Oldest unpinned entry NOT belonging to ``incoming_run`` (see
@@ -419,6 +438,33 @@ class DeviceBatchCache:
             if e.pins == 0 and e.resident:
                 self._pinned_bytes -= e.nbytes
                 self._run_unpinned_locked(e.run, e.nbytes)
+
+    def evict_unpinned(self, run=None) -> tuple[int, int]:
+        """Evict EVERY unpinned entry (all runs — or only ``run``'s
+        when given), returning ``(entries, bytes_freed)``. The device
+        OOM recovery rung (FAULTS.md): before retrying an allocation
+        that just failed, hand the allocator back everything the cache
+        holds speculatively. Pinned entries — buffers an in-flight
+        dispatch still reads — stay, so the budget stays honest."""
+        from tpudl.obs import metrics as _m
+
+        freed = count = 0
+        with self._lock:
+            victims = [e for e in self._entries.values()
+                       if e.pins <= 0
+                       and (run is None or e.run == run)]
+            for e in victims:
+                del self._entries[e.key]
+                e.resident = False
+                self._bytes -= e.nbytes
+                self._run_unpinned_locked(e.run, -e.nbytes)
+                freed += e.nbytes
+                count += 1
+            resident = self._bytes
+        if count:
+            _m.counter("data.hbm.evictions").inc(count)
+        _m.gauge("data.hbm.bytes_resident").set(resident)
+        return count, freed
 
     def clear(self) -> None:
         from tpudl.obs import metrics as _m
@@ -455,6 +501,16 @@ def reset_device_cache() -> None:
         if _CACHE is not None:
             _CACHE.clear()
         _CACHE = None
+
+
+def count_put_failed() -> None:
+    """One batch failed to become resident because its device placement
+    (or its metadata probe) threw mid-way — the tallies stayed
+    consistent and the batch fell back to the plain wire path; this
+    counter is the operator's evidence that residency is degrading."""
+    from tpudl.obs import metrics as _m
+
+    _m.counter("data.hbm.put_failed").inc()
 
 
 def count_donation_blocked() -> None:

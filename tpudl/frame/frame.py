@@ -566,29 +566,10 @@ class Frame:
         for start in range(0, self._n, batch_size):
             yield start, min(start + batch_size, self._n)
 
-    def map_batches(
-        self,
-        fn: Callable,
-        input_cols: Sequence[str],
-        output_cols: Sequence[str],
-        *,
-        batch_size: int | None = None,
-        mesh=None,
-        pack: Callable | None = None,
-        check_finite: bool = False,
-        prefetch: bool | None = None,
-        prefetch_depth: int | None = None,
-        prepare_workers: int | None = None,
-        fuse_steps: int | None = None,
-        dispatch_depth: int | None = None,
-        donate: bool | None = None,
-        autotune: bool | None = None,
-        device_fn: bool | None = None,
-        wire_codec=None,
-        cache_dir: str | None = None,
-        cache_key: str | None = None,
-        device_cache: bool | None = None,
-    ) -> "Frame":
+    def map_batches(self, fn: Callable, input_cols: Sequence[str],
+                    output_cols: Sequence[str], *,
+                    supervise: bool | None = None,
+                    **kwargs) -> "Frame":
         """Run ``fn`` over the frame in device-sized batches; append outputs.
 
         ``fn`` maps packed input arrays → one array or a tuple matching
@@ -696,7 +677,68 @@ class Frame:
           residency forces ``fuse_steps`` to 1: fusion amortizes the
           per-dispatch round-trip by re-stacking HOST batches, which
           would defeat the residency it rides with. Device fns only.
+        ``supervise`` (env ``TPUDL_FRAME_DEGRADE``, default OFF): arm
+        the fault-containment supervisor (FAULTS.md,
+        :mod:`tpudl.frame.supervisor`). Classified executor faults
+        retry the run down a bounded degradation ladder — device OOM
+        evicts unpinned HBM-cache entries and retries, transient
+        transfer/IO faults ride the ONE shared RetryPolicy, repeated
+        stage faults halve ``dispatch_depth``, then drop ``fuse_steps``
+        to 1, then disable donation, then fall back to the conservative
+        serial arm — every rung bitwise-identical to a healthy run of
+        that config, recorded as a ``frame.degraded`` flight event and
+        on the report (``degraded_to``, ``recovered_batches``).
+        Exhaustion (``TPUDL_FRAME_DEGRADE_MAX_RUNGS``) writes a flight
+        dump and raises a TYPED taxonomy error (``DeviceOOM`` /
+        ``TransferError`` / ``RecompileStorm`` / ``StageFault``)
+        chained to the original — never a raw pool-unwind error.
         """
+        from tpudl.frame import supervisor as _sup
+
+        if not _sup.enabled(supervise):
+            # unarmed: ONE env read, straight into the executor (the
+            # overhead guard in tests/test_supervisor.py pins this)
+            return self._map_batches_impl(fn, input_cols, output_cols,
+                                          **kwargs)
+        sup = _sup.Supervisor()
+
+        def attempt(overrides):
+            kw = dict(kwargs)
+            kw.update(overrides)  # rung knobs beat the caller's
+            return self._map_batches_impl(fn, input_cols, output_cols,
+                                          _supervisor=sup, **kw)
+
+        return sup.supervise(attempt)
+
+    def _map_batches_impl(
+        self,
+        fn: Callable,
+        input_cols: Sequence[str],
+        output_cols: Sequence[str],
+        *,
+        batch_size: int | None = None,
+        mesh=None,
+        pack: Callable | None = None,
+        check_finite: bool = False,
+        prefetch: bool | None = None,
+        prefetch_depth: int | None = None,
+        prepare_workers: int | None = None,
+        fuse_steps: int | None = None,
+        dispatch_depth: int | None = None,
+        donate: bool | None = None,
+        autotune: bool | None = None,
+        device_fn: bool | None = None,
+        wire_codec=None,
+        cache_dir: str | None = None,
+        cache_key: str | None = None,
+        device_cache: bool | None = None,
+        _supervisor=None,
+    ) -> "Frame":
+        """One executor attempt: the full staged pipeline (the
+        public :meth:`map_batches` carries the user-facing contract
+        and, when supervision is armed, retries this body down the
+        degradation ladder — ``_supervisor`` is its ladder-state
+        handle)."""
         if batch_size is None:
             if self.num_partitions:
                 batch_size = max(1, -(-self._n // int(self.num_partitions)))
@@ -965,6 +1007,11 @@ class Frame:
             "device_cache": bool(dcache is not None),
         }
         obs.set_last_pipeline(report)
+        if _supervisor is not None:
+            # fault containment (frame.supervisor): the ladder reads
+            # the RESOLVED config off this report (what to halve) and
+            # recovery stamps degraded_to/recovered_batches onto it
+            _supervisor.note_report(report)
 
         # mesh transfer placement, captured ONCE: fuse==1 runs transfer
         # on the prepare pool (copies start as early as possible and
@@ -1161,7 +1208,16 @@ class Frame:
                                 for a in packed), run=dkey):
                         import jax
 
-                        packed = jax.device_put(list(packed))
+                        try:
+                            packed = jax.device_put(list(packed))
+                        except BaseException:
+                            # a placement that dies mid-way (device OOM
+                            # is likeliest right here) never touched
+                            # the cache tallies — count it and let the
+                            # error propagate to the supervisor, whose
+                            # OOM rung evicts and retries
+                            _dc.count_put_failed()
+                            raise
                         pin = dcache.put((dkey, bidx), packed,
                                          n_pad=n_pad, codecs=codecs)
                     if pin is not None:

@@ -1,0 +1,506 @@
+"""Fault-contained executor supervision: taxonomy + degradation ladder.
+
+PRs 10-12 built a deep fast path — D-deep async dispatch windows, fused
+mesh transfers, buffer donation, HBM residency — where a single device
+OOM, transfer failure or wedged stage today propagates as a raw XLA
+error through the pool unwind: the whole ``map_batches`` run dies for a
+fault the executor could have absorbed. This module is the containment
+layer (FAULTS.md is the operator guide):
+
+1. **taxonomy** — :func:`classify_exception` sorts an executor-stage
+   exception into a typed kind, anchored on the XLA runtime-error
+   message (``RESOURCE_EXHAUSTED`` → device OOM), the failing stage
+   (the ``tpudl_stage`` tag :meth:`PipelineReport.stage` leaves on an
+   escaping exception), the shared retry classifier
+   (:mod:`tpudl.jobs.retry`: IO-shaped = transient, programming errors
+   and ``tpudl_fatal`` = never retried), and the traceck sentinel's
+   storm counter. The typed exceptions (:class:`DeviceOOM`,
+   :class:`TransferError`, :class:`RecompileStorm`, :class:`StageFault`,
+   :class:`Fatal`) are what a supervised run raises when recovery is
+   exhausted — always chained to the original error;
+
+2. **degradation ladder** — a :class:`Supervisor` retries the whole run
+   under a bounded, ORDERED sequence of rungs instead of dying:
+
+   - device OOM first evicts every unpinned HBM-cache entry
+     (``evict_hbm``) and retries with the wire path intact;
+   - transient transfer/IO faults route through the ONE shared
+     :class:`tpudl.jobs.retry.RetryPolicy` (``io_policy()`` — same
+     attempts/backoff knobs as every other IO retry in the tree,
+     every attempt in ``retry.frame.transfer`` + the flight error
+     ring);
+   - repeated stage faults walk the ladder: halve ``dispatch_depth``
+     (repeatedly, to 1), then drop ``fuse_steps`` to 1, then disable
+     donation, then fall back to the conservative serial arm (the
+     ``TPUDL_MESH_FAST_PATH=0`` shape: no prefetch, no window, no
+     fusion, no donation, no residency).
+
+   Every rung preserves the bitwise-parity contract the
+   depth×donate×fuse matrices already pin (tests/test_frame_async.py,
+   test_mesh_executor.py): a degraded run returns the SAME bytes as a
+   healthy one, only slower. Rungs are bounded by
+   ``TPUDL_FRAME_DEGRADE_MAX_RUNGS``; exhaustion writes a flight dump
+   and raises the typed error for the last fault kind.
+
+Supervision is an OPERATOR decision, off by default
+(``TPUDL_FRAME_DEGRADE=1`` or ``map_batches(supervise=True)`` arms it):
+retrying a run re-executes user code, so the layer that owns the
+process — the serving subsystem, a long bench, a production job — arms
+it deliberately, exactly like ``device_cache``. Unarmed cost is one env
+read per run (the executor overhead guard in tests/test_supervisor.py
+pins it under the same <5% envelope as the recorder+watchdog).
+
+Observability: every rung files a ``frame.degraded`` event into the
+flight recorder's error ring and bumps ``frame.degraded.rungs``;
+recovery lands ``degraded_to`` + ``recovered_batches`` on the
+PipelineReport (surfaced by ``obs top``); exhaustion bumps
+``frame.degraded.exhausted`` and leaves a schema-valid
+``tpudl-dump-*`` whose death ``obs doctor`` classifies as
+``degraded_run``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from tpudl.jobs import retry as _retry
+
+__all__ = ["FaultError", "DeviceOOM", "TransferError", "RecompileStorm",
+           "StageFault", "Fatal", "Supervisor", "classify_exception",
+           "enabled", "LADDER"]
+
+log = logging.getLogger("tpudl.frame.supervisor")
+
+# the ordered ladder (FAULTS.md): generic stage faults walk these rungs
+# top to bottom; "dispatch_depth" repeats (halving) until depth is 1
+LADDER = ("dispatch_depth", "fuse_steps", "donate", "serial")
+
+# covers evict_hbm + depth 8 -> 1 (3 halvings) + fuse + donate; the
+# serial rung is guaranteed ONE attempt even past this budget (the
+# last resort is never left untried), so total rungs <= max_rungs + 1
+DEFAULT_MAX_RUNGS = 6
+
+
+# -- the typed taxonomy ------------------------------------------------------
+class FaultError(RuntimeError):
+    """Base of the executor fault taxonomy. Raised by a supervised run
+    when the degradation ladder is exhausted — always ``raise ... from``
+    the original exception, so the raw XLA/IO error stays attached.
+    ``stage`` is the executor stage the last fault escaped from (the
+    ``tpudl_stage`` tag), ``rungs`` the ladder rungs that were tried."""
+
+    kind = "stage"
+
+    def __init__(self, message: str, *, stage: str | None = None,
+                 rungs=()):
+        super().__init__(message)
+        self.stage = stage
+        self.rungs = tuple(rungs)
+
+
+class DeviceOOM(FaultError):
+    """Device memory exhausted (XLA ``RESOURCE_EXHAUSTED``): recovery
+    evicts unpinned HBM-cache entries and retries; shrinking rungs
+    (smaller window, no fusion) follow if it recurs."""
+
+    kind = "oom"
+
+
+class TransferError(FaultError):
+    """Host→device transfer / IO fault at the infeed edge: transient
+    ones ride the shared RetryPolicy; a persistent one degrades and
+    eventually raises this."""
+
+    kind = "transfer"
+
+
+class RecompileStorm(FaultError):
+    """The traceck sentinel counted new storms during the failed
+    attempt: the run was recompiling instead of computing. Recovery
+    pins fuse_steps/autotune down to stop the program-shape churn."""
+
+    kind = "recompile_storm"
+
+
+class StageFault(FaultError):
+    """An executor stage failed for no more specific reason — the
+    generic ladder (depth, fusion, donation, serial) handles it."""
+
+    kind = "stage"
+
+
+class Fatal(FaultError):
+    """Not recoverable by ANY rung (programming error, preemption,
+    interpreter shutdown). ``tpudl_fatal`` keeps every retry layer —
+    this one, gang restarts, trial retries — from fighting it."""
+
+    kind = "fatal"
+    tpudl_fatal = True
+
+
+_TYPE_FOR = {"oom": DeviceOOM, "transfer": TransferError,
+             "recompile_storm": RecompileStorm, "stage": StageFault,
+             "fatal": Fatal}
+
+# device-OOM anchoring: jaxlib raises XlaRuntimeError whose text leads
+# with the grpc-style status name. The bare "out of memory" wording
+# only counts on that TYPE — a user fn raising RuntimeError('CUDA out
+# of memory') from some other library must not trigger a process-wide
+# HBM eviction (classified "stage", handled by the generic ladder)
+_OOM_STATUS = "RESOURCE_EXHAUSTED"
+# exception kinds that never benefit from a retry at ANY rung: the
+# shared contract with tpudl.jobs.retry (PROGRAMMING_ERRORS, tpudl_fatal
+# and the interpreter-shutdown set), plus schema errors the executor
+# raises before any batch runs (unknown columns, bad output arity)
+_SETUP_ERRORS = (KeyError,)
+
+
+def classify_exception(exc: BaseException, *, stage: str | None = None,
+                       storm: bool = False) -> str:
+    """One executor-attempt exception → a taxonomy kind
+    (``oom`` / ``transfer`` / ``recompile_storm`` / ``stage`` /
+    ``fatal``). ``stage`` is the ``tpudl_stage`` tag the innermost
+    :meth:`PipelineReport.stage` block left on the exception; ``storm``
+    says whether the traceck sentinel counted new storms during the
+    attempt (the supervisor samples ``traceck.storms`` around it)."""
+    if (_retry.is_fatal(exc)
+            or isinstance(exc, _retry.PROGRAMMING_ERRORS)
+            or isinstance(exc, _SETUP_ERRORS)):
+        return "fatal"
+    msg = str(exc)
+    if _OOM_STATUS in msg or (
+            type(exc).__name__ == "XlaRuntimeError"
+            and "out of memory" in msg.lower()):
+        return "oom"
+    if storm:
+        return "recompile_storm"
+    # the transfer edge: either the fault escaped the h2d stage, or it
+    # is IO-shaped per the ONE retry classifier's transient default
+    if stage == "h2d" or isinstance(
+            exc, (OSError, ConnectionError, TimeoutError,
+                  InterruptedError)):
+        return "transfer"
+    return "stage"
+
+
+def enabled(kwarg=None) -> bool:
+    """Is supervision armed for this run? The explicit ``supervise=``
+    kwarg wins; else ``TPUDL_FRAME_DEGRADE`` (default OFF — arming
+    changes which exception TYPE a failing run raises and re-executes
+    user code on retry, so the process owner opts in)."""
+    if kwarg is not None:
+        return bool(kwarg)
+    return os.environ.get("TPUDL_FRAME_DEGRADE", "0") == "1"
+
+
+def _storms() -> float:
+    """Current traceck storm count (0 when the sentinel is unarmed —
+    the counter simply never moves)."""
+    from tpudl.obs import metrics as _m
+
+    return float(_m.counter("traceck.storms").value)
+
+
+class Supervisor:
+    """One supervised run's ladder state. Single-consumer by design:
+    the supervise loop, classification and rung bookkeeping all run on
+    the thread that called ``map_batches`` (pool threads only ever
+    RAISE into it via the infeed/window unwind), so no lock is
+    needed."""
+
+    def __init__(self, *, max_rungs: int | None = None):
+        self.max_rungs = (int(max_rungs) if max_rungs is not None
+                          else max(1, _retry._env_int(
+                              "TPUDL_FRAME_DEGRADE_MAX_RUNGS",
+                              DEFAULT_MAX_RUNGS)))
+        self.rungs: list[str] = []      # applied rung labels, in order
+        self.overrides: dict = {}       # kwargs for the next attempt
+        self.recovered_batches = 0
+        self.transfer_attempts = 0      # shared-RetryPolicy budget used
+        self.hbm_evicted = False        # the OOM evict rung fired
+        self._ladder_pos = 0            # index into LADDER
+        self._report = None             # current attempt's PipelineReport
+        self._hb = None
+
+    # -- executor hooks ------------------------------------------------------
+    def note_report(self, report) -> None:
+        """Called by the executor once per attempt, right after the
+        attempt's PipelineReport config is resolved — the ladder reads
+        the RESOLVED knob values (env/autotune included) to know what
+        to halve, and recovery stamps its outcome onto this report."""
+        self._report = report
+        if self.rungs:
+            report.config["degraded_to"] = self.degraded_to
+
+    @property
+    def degraded_to(self) -> str | None:
+        """The deepest rung applied so far (what the run degraded TO),
+        e.g. ``dispatch_depth=1``, ``serial`` — the PipelineReport /
+        ``obs top`` field."""
+        return self.rungs[-1] if self.rungs else None
+
+    # -- the supervise loop --------------------------------------------------
+    def supervise(self, attempt):
+        """Run ``attempt(overrides)`` under the ladder: classified
+        recoverable faults apply a rung and re-run; fatal faults and
+        ladder exhaustion re-raise (typed). The whole-run retry is what
+        keeps recovery bitwise-honest: partial outputs of a failed
+        attempt are discarded, and the surviving attempt's outputs are
+        exactly what a healthy run of that config produces."""
+        from tpudl.obs import watchdog as _watchdog
+
+        attempt_no = 0
+        # one heartbeat for the whole supervised run, PARENT of each
+        # attempt's executor heartbeat (nested registration): it is
+        # re-armed by every attempt start, every rung and every backoff
+        # slice, so a stage the supervisor is actively retrying is
+        # never double-flagged as a stall
+        with _watchdog.heartbeat("frame.supervisor",
+                                 max_rungs=self.max_rungs) as hb:
+            self._hb = hb
+            while True:
+                attempt_no += 1
+                hb.beat(attempt=attempt_no, rungs=len(self.rungs))
+                storms0 = _storms()
+                try:
+                    result = attempt(dict(self.overrides))
+                except BaseException as e:
+                    kind = classify_exception(
+                        e, stage=getattr(e, "tpudl_stage", None),
+                        storm=_storms() > storms0)
+                    if kind == "fatal":
+                        raise
+                    self._on_fault(e, kind, attempt_no)  # raises typed
+                    continue                             # ... or rung'd
+                if self.rungs:
+                    # only a RUNG'D run records recovery: a transfer
+                    # retry changed no knob and already left its trail
+                    # as retry.frame.transfer — stamping it here would
+                    # over-report degradation (the frame.degraded.*
+                    # registry contract)
+                    self._record_recovery()
+                return result
+
+    # -- fault handling ------------------------------------------------------
+    def _on_fault(self, exc: BaseException, kind: str,
+                  attempt_no: int) -> None:
+        """Pick and apply the next rung for ``kind`` — or, when the
+        ladder is exhausted, dump the black box and raise the typed
+        error chained to ``exc``."""
+        stage = getattr(exc, "tpudl_stage", None)
+        if kind == "transfer" and self._retry_transfer(exc, stage):
+            return
+        if (kind == "oom" and not self.hbm_evicted
+                and len(self.rungs) < self.max_rungs):
+            # budget-checked like every ladder rung, so the documented
+            # "total rungs <= max_rungs + 1" bound holds (only the
+            # guaranteed serial attempt may exceed the budget)
+            self.hbm_evicted = True
+            freed = self._evict_hbm()
+            self._apply_rung("evict_hbm", exc, stage, attempt_no,
+                             freed_bytes=freed)
+            return
+        if kind == "recompile_storm" and "fuse_steps" not in [
+                r.split("=")[0] for r in self.rungs]:
+            # stop the program-shape churn first: one fused variant
+            # fewer per retrace beats shrinking the window
+            if self._ladder_pos < 1:
+                self._ladder_pos = 1  # skip ahead to the fuse rung
+        over_budget = len(self.rungs) >= self.max_rungs
+        label = None if over_budget else self._next_ladder_rung()
+        if label is None:
+            # out of budget, or out of intermediate rungs: the
+            # conservative serial arm is the documented LAST RESORT
+            # and always gets its one attempt before the typed raise —
+            # an eviction or a deep halving sequence consuming the
+            # budget must not leave the rung most likely to survive
+            # untried
+            if "serial" in self.rungs:
+                self._exhausted(exc, kind, stage)
+            label = self._jump_to_serial()
+        self._apply_rung(label, exc, stage, attempt_no)
+
+    def _cfg(self, key, default):
+        """The knob value the NEXT attempt will run: an override this
+        ladder already applied wins over the last attempt's resolved
+        report config (consecutive halvings must see each other)."""
+        if key in self.overrides:
+            return self.overrides[key]
+        cfg = self._report.config if self._report is not None else {}
+        v = cfg.get(key)
+        return default if v is None else v
+
+    def _next_ladder_rung(self) -> str | None:
+        """The next applicable rung label, advancing ``_ladder_pos``
+        past rungs the current config makes a no-op (depth already 1,
+        fusion already off, ...)."""
+        while self._ladder_pos < len(LADDER):
+            rung = LADDER[self._ladder_pos]
+            if rung == "dispatch_depth":
+                depth = int(self._cfg("dispatch_depth", 1))
+                if depth > 1:
+                    half = max(1, depth // 2)
+                    self.overrides["dispatch_depth"] = half
+                    return f"dispatch_depth={half}"  # stay on this rung
+                self._ladder_pos += 1
+            elif rung == "fuse_steps":
+                self._ladder_pos += 1
+                if int(self._cfg("fuse_steps", 1)) > 1:
+                    self.overrides["fuse_steps"] = 1
+                    self.overrides["autotune"] = False
+                    return "fuse_steps=1"
+            elif rung == "donate":
+                self._ladder_pos += 1
+                if bool(self._cfg("donate", False)):
+                    self.overrides["donate"] = False
+                    return "donate=off"
+            else:  # serial: the conservative arm, always applicable once
+                return self._jump_to_serial()
+        return None
+
+    def _jump_to_serial(self) -> str:
+        """Apply the last-resort rung (the ``TPUDL_MESH_FAST_PATH=0``
+        shape) and close the ladder behind it."""
+        self._ladder_pos = len(LADDER)
+        self.overrides.update(
+            prefetch=False, fuse_steps=1, dispatch_depth=1,
+            donate=False, autotune=False, device_cache=False)
+        return "serial"
+
+    def _retry_transfer(self, exc: BaseException,
+                        stage: str | None) -> bool:
+        """Route one transfer/IO fault through the ONE shared
+        RetryPolicy (``tpudl.jobs.retry.io_policy`` — the same
+        attempts/backoff budget as every file read in the tree). True =
+        a retry attempt was paid for (no knob change); False = the
+        policy's budget is spent and the fault falls through to the
+        ladder."""
+        pol = _retry.io_policy()
+        self.transfer_attempts += 1
+        if self.transfer_attempts >= pol.max_attempts:
+            return False
+        delay = pol.backoff_s(self.transfer_attempts)
+        # a retry is NOT a degradation: no knob changed, so it must
+        # not touch frame.degraded.* nor the frame.degraded ring (the
+        # doctor's degraded_run evidence would over-report). The
+        # policy's own record() already files retry.frame.transfer
+        # into the metrics + the flight error ring — the same trail as
+        # every other io_policy consumer
+        pol.record("frame.transfer", exc,
+                   attempt=self.transfer_attempts, backoff_s=delay)
+        log.warning(
+            "frame.supervisor: transfer fault %s in stage %s — retry "
+            "%d/%d via the shared io_policy (backoff %.3fs)",
+            type(exc).__name__, stage or "?", self.transfer_attempts,
+            pol.max_attempts - 1, delay)
+        self._sleep_with_beats(delay)
+        return True
+
+    def _evict_hbm(self) -> int:
+        """The OOM rung's action: evict every unpinned device-cache
+        entry, freeing HBM for the retry (pinned entries — buffers an
+        in-flight dispatch of ANOTHER run still reads — stay)."""
+        try:
+            from tpudl.data import device_cache as _dc
+
+            _n, freed = _dc.get_device_cache().evict_unpinned()
+            return freed
+        # best-effort recovery action: a cache that cannot evict (torn
+        # import mid-OOM) just means the retry runs against the same
+        # memory pressure, and the ladder's shrinking rungs still follow
+        except Exception:
+            return 0
+
+    def _apply_rung(self, label: str, exc: BaseException,
+                    stage: str | None, attempt_no: int, **extra) -> None:
+        self.rungs.append(label)
+        self._record_rung(label, exc, stage, attempt=attempt_no,
+                          **extra)
+
+    def _record_rung(self, label: str, exc: BaseException,
+                     stage: str | None, **extra) -> None:
+        """One degradation event: flight error ring (kind
+        ``frame.degraded``) + ``frame.degraded.rungs`` + a warning —
+        recovery is silent for the caller, loud for the operator."""
+        try:
+            from tpudl.obs import flight as _flight
+            from tpudl.obs import metrics as _m
+
+            _m.counter("frame.degraded.rungs").inc()
+            _flight.record_error(
+                "frame.degraded", exc, rung=label, stage=stage,
+                rungs_applied=len(self.rungs), **extra)
+        # tpudl: ignore[swallowed-except] — the observer must never
+        # take down the recovery it narrates
+        except Exception:
+            pass
+        log.warning(
+            "frame.supervisor: %s after %s in stage %s — retrying "
+            "(rung %d/%d)", label, type(exc).__name__, stage or "?",
+            len(self.rungs), self.max_rungs)
+
+    def _record_recovery(self) -> None:
+        """The run survived on a degraded rung: stamp the outcome onto
+        the surviving attempt's report + the process-wide counters."""
+        batches = 0
+        if self._report is not None:
+            calls = self._report.report().get("stage_calls") or {}
+            batches = int(calls.get("dispatch", 0))
+            if self.degraded_to is not None:
+                self._report.config["degraded_to"] = self.degraded_to
+            self._report.config["recovered_batches"] = batches
+        self.recovered_batches = batches
+        try:
+            from tpudl.obs import metrics as _m
+
+            _m.counter("frame.degraded.recovered_batches").inc(batches)
+        # tpudl: ignore[swallowed-except] — the observer must never
+        # take down the recovery it narrates
+        except Exception:
+            pass
+
+    def _exhausted(self, exc: BaseException, kind: str,
+                   stage: str | None) -> None:
+        """Out of rungs: file the counters, write the black box, and
+        raise the TYPED error chained to the original — the acceptance
+        contract is "recovers bitwise or exits typed with a dump",
+        never a raw pool-unwind error and never a hang."""
+        try:
+            from tpudl.obs import flight as _flight
+            from tpudl.obs import metrics as _m
+
+            _m.counter("frame.degraded.exhausted").inc()
+            # ctx key is fault_kind, NOT kind: record_error's first
+            # positional is already named kind (the PR-7 kwarg-collision
+            # class, regression-tested in test_supervisor.py)
+            _flight.record_error(
+                "frame.degraded.exhausted", exc, fault_kind=kind,
+                stage=stage, rungs=",".join(self.rungs) or None)
+            _flight.dump(reason="degraded_exhausted", error=exc)
+        # tpudl: ignore[swallowed-except] — forensics are best-effort;
+        # the typed raise below must happen regardless
+        except Exception:
+            pass
+        cls = _TYPE_FOR.get(kind, StageFault)
+        raise cls(
+            f"map_batches fault not recoverable after "
+            f"{len(self.rungs)} degradation rung(s) "
+            f"({', '.join(self.rungs) or 'none applicable'}): "
+            f"{type(exc).__name__}: {exc}",
+            stage=stage, rungs=self.rungs) from exc
+
+    def _sleep_with_beats(self, seconds: float) -> None:
+        """Backoff that stays visibly ALIVE: slept in slices with a
+        heartbeat beat per slice, so the watchdog never flags a
+        supervised retry's deliberate pause as a stall (the
+        heartbeat-re-arm contract, tests/test_obs_flight.py)."""
+        deadline = time.monotonic() + max(0.0, float(seconds))
+        while True:
+            if self._hb is not None:
+                self._hb.beat(backing_off=True)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            time.sleep(min(0.05, remaining))

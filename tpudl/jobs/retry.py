@@ -13,7 +13,11 @@ and a transient-vs-fatal classifier — now sits under all of them:
 - ``tpudl.data.cached_uri_load`` bulk-load chunks and image file reads
   (``io_policy()``, tuned by ``TPUDL_RETRY_IO_ATTEMPTS`` /
   ``TPUDL_RETRY_IO_BACKOFF_S``);
-- per-trial retries in ``TrialScheduler.run``.
+- per-trial retries in ``TrialScheduler.run``;
+- the fault-containment supervisor (``tpudl.frame.supervisor``,
+  FAULTS.md): transient transfer/IO faults at the executor's H2D edge
+  spend the SAME ``io_policy()`` attempts/backoff budget
+  (``retry.frame.transfer``) before the degradation ladder engages.
 
 Every retry is visible: ``retry.attempts`` / ``retry.<kind>`` counters
 in the metrics registry (surfaced by ``obs top``) and one entry per

@@ -26,6 +26,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpudl.testing import faults as _faults
+
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
@@ -145,6 +147,10 @@ def transfer_batch(tree, mesh: Mesh, axis: str = DATA_AXIS, *,
     untouched: zero wire bytes, and crucially no ``np.asarray`` — the
     old unconditional host staging would have GATHERED the resident
     shard back to host just to re-ship it."""
+    # THE transfer fault point (tpudl.testing.faults): the chaos suite
+    # injects transfer failures at the one edge every mesh H2D crosses;
+    # unarmed this is a global None-check
+    _faults.fire("mesh.transfer")
     leaves, treedef = jax.tree.flatten(tree)
     shardings = [
         (stacked_batch_sharding(mesh, axis, np.ndim(x)) if batch_dim == 1

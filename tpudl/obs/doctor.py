@@ -21,6 +21,17 @@ gang), merge per-host evidence and CLASSIFY the failure:
   was recompiling instead of computing — ranked beside (and checked
   before) ``dispatch_slowdown``, because a storm IS the usual cause
   of a slow dispatch that nobody can explain;
+- ``degraded_run`` — the fault-containment supervisor
+  (tpudl.frame.supervisor, FAULTS.md) was walking its degradation
+  ladder when the process died: ``frame.degraded.*`` metrics and the
+  ``frame.degraded`` error-ring events name the rungs and the faults
+  that triggered them. Checked after the storm rules (a storm explains
+  WHY the run was degrading) and before the stall rules — a run the
+  supervisor was actively retrying is not "stuck", it is recovering,
+  and the actionable fact is which rung it died on. Gated on
+  degradation being CURRENT at death (the exhaustion dump, the
+  supervisor's live heartbeat, or the newest report's ``degraded_to``)
+  so one long-recovered fault never reroutes a later unrelated death;
 - ``dispatch_slowdown`` — a stall (or dominant stage share) in
   ``dispatch``: the device/backend stopped answering or slowed;
 - ``clean_external_kill`` — a SIGTERM/SIGQUIT dump with no stall and
@@ -314,6 +325,65 @@ def classify(merged: dict) -> dict:
                         "<paths>)")
         return {"classification": "recompile_storm",
                 "suspect_stage": "dispatch",
+                "suspect_host": suspect_host,
+                "evidence": evidence, "stage_rates": rates}
+
+    # 2c. degraded run: the fault-containment supervisor was mid-ladder
+    #     when the process died — the rung trail is the diagnosis (and
+    #     a killed retrying run must not read as a generic stall).
+    #     ``exhausted`` dumps carry their own typed error; both shapes
+    #     land here so a degraded-then-killed run is one class.
+    #     Gated on degradation being CURRENT at death — the exhaustion
+    #     dump itself, the supervisor's heartbeat still registered, or
+    #     the NEWEST pipeline report carrying degraded_to — never on
+    #     the cumulative counters alone: one long-recovered fault early
+    #     in a process's life must not reroute every later unrelated
+    #     death away from the stall/kill classes
+    degr_rungs = sum(_metric_value(d, "frame.degraded.rungs")
+                     for d in hosts.values())
+    degr_ring = [e for e in errors
+                 if str(e.get("kind", "")).startswith("frame.degraded")]
+    sup_hb = (newest.get("heartbeats") or {}).get(
+        "frame.supervisor") or {}
+    degr_current = (
+        reason == "degraded_exhausted"
+        # a LIVE supervisor heartbeat only counts when ITS run has
+        # actually applied rungs (it beats rungs=len(self.rungs)):
+        # under process-wide TPUDL_FRAME_DEGRADE=1 every supervised
+        # run registers one, and mere presence would let a stale
+        # recovered fault reroute a later unrelated death
+        or int((sup_hb.get("info") or {}).get("rungs") or 0) > 0
+        or bool(report and report.get("degraded_to")))
+    if degr_current and (degr_rungs or degr_ring):
+        exhausted = sum(_metric_value(d, "frame.degraded.exhausted")
+                        for d in hosts.values())
+        recovered = sum(
+            _metric_value(d, "frame.degraded.recovered_batches")
+            for d in hosts.values())
+        evidence.insert(0, (
+            f"the executor supervisor applied {degr_rungs:.0f} "
+            f"degradation rung(s) before death"
+            + (f"; ladder EXHAUSTED {exhausted:.0f} time(s) "
+               "(typed error + this dump)" if exhausted else "")
+            + (f"; {recovered:.0f} batch(es) recovered on degraded "
+               "rungs" if recovered else "")))
+        suspect = None
+        for e in degr_ring[-3:]:
+            evidence.append(
+                f"rung: {e.get('rung', e.get('kind'))} after "
+                f"{e.get('type')} in stage {e.get('stage')!r}")
+            suspect = e.get("stage") or suspect
+        if stalls:
+            last = stalls[-1]
+            evidence.append(
+                f"history: watchdog flagged {len(stalls)} stall(s); "
+                f"last: {last.get('name')} frozen {last.get('age_s')}s "
+                f"in stage {_stall_stage(last) or 'unknown'!r}")
+        evidence.append("the rung trail + FAULTS.md name the knob that "
+                        "was being degraded; fix the underlying fault "
+                        "(ring entries carry the original exception)")
+        return {"classification": "degraded_run",
+                "suspect_stage": suspect,
                 "suspect_host": suspect_host,
                 "evidence": evidence, "stage_rates": rates}
 

@@ -53,7 +53,8 @@ STATUS_PREFIX = "tpudl-status-"
 
 _METRIC_PREFIXES = ("train.", "hpo.", "udf.", "estimator.",
                     "obs.watchdog.", "obs.roofline.",
-                    "frame.map_batches.", "retry.", "data.hbm.")
+                    "frame.map_batches.", "frame.degraded.", "retry.",
+                    "data.hbm.")
 
 
 def _status_dir() -> str | None:
@@ -97,7 +98,7 @@ def _run_entry(report: dict) -> dict:
         "config": {k: report.get(k) for k in (
             "executor", "batch_size", "fuse_steps", "prefetch_depth",
             "prepare_workers", "wire_codec", "batch_cache",
-            "device_cache")
+            "device_cache", "degraded_to", "recovered_batches")
             if report.get(k) is not None},
     }
     if rows_total:
@@ -412,7 +413,12 @@ def render(statuses: list[dict], now: float | None = None) -> str:
                 + (f" ({pct:.0f}%)" if pct is not None else "")
                 + f" |{_bar(pct)}|"
                 + (f" {rate:.1f} rows/s" if rate else "")
-                + (f" ETA {_fmt_age(eta)}" if eta is not None else ""))
+                + (f" ETA {_fmt_age(eta)}" if eta is not None else "")
+                # fault containment: a run surviving on a degraded rung
+                # is loud here — same field the PipelineReport carries
+                + (f" DEGRADED->{(run.get('config') or {})['degraded_to']}"
+                   if (run.get("config") or {}).get("degraded_to")
+                   else ""))
             ss = run.get("stage_seconds") or {}
             if ss:
                 stages = "  ".join(f"{k} {v:.2f}s" for k, v

@@ -114,6 +114,21 @@ class PipelineReport:
             t0 = time.perf_counter()
             try:
                 yield
+            except BaseException as e:
+                # fault-taxonomy hook (tpudl.frame.supervisor): tag the
+                # escaping exception with the INNERMOST stage it left —
+                # outer stage blocks see the tag set and keep it, so a
+                # mesh-transfer fault inside prepare's nested h2d block
+                # classifies as a transfer fault, not a prepare one
+                if getattr(e, "tpudl_stage", None) is None:
+                    try:
+                        e.tpudl_stage = name
+                    # tpudl: ignore[swallowed-except] — exceptions with
+                    # __slots__/immutable attrs just stay untagged; the
+                    # classifier falls back to type/message anchoring
+                    except Exception:
+                        pass
+                raise
             finally:
                 self.add(name, time.perf_counter() - t0)
                 if hb is not None:

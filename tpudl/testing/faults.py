@@ -17,6 +17,12 @@ watch it recover. This module is that demand side:
 
   - ``raise`` — raise a chosen exception type (stage faults,
     transient IO errors with recovery-after-K via ``first_calls``);
+  - ``oom`` — raise a realistic device-OOM: the REAL
+    ``XlaRuntimeError`` type when jaxlib is importable (a message-
+    compatible stand-in otherwise), with the ``RESOURCE_EXHAUSTED: Out
+    of memory while trying to allocate N bytes.`` text the supervisor's
+    taxonomy anchors on — so OOM recovery (evict-and-retry,
+    FAULTS.md) is testable without a real device;
   - ``sigterm`` — SIGTERM-to-self (the preemption kill, delivered at
     an exact step instead of a racy external timer);
   - ``corrupt`` — flip one byte of the file named by the firing's
@@ -46,7 +52,7 @@ import time
 from tpudl.testing import tsan as _tsan
 
 __all__ = ["FaultPlan", "FaultInjected", "arm", "disarm", "fire",
-           "install_from_env", "PLAN_ENV"]
+           "install_from_env", "oom_error", "PLAN_ENV"]
 
 PLAN_ENV = "TPUDL_FAULT_PLAN"
 
@@ -56,6 +62,42 @@ _ARM_LOCK = _tsan.named_lock("testing.faults.arm")
 
 class FaultInjected(RuntimeError):
     """Default exception for ``raise`` rules that don't name one."""
+
+
+class _StandInXlaRuntimeError(RuntimeError):
+    """Stand-in mirroring jaxlib's XlaRuntimeError when jaxlib is not
+    importable: classifiers anchor on the type NAME + the
+    RESOURCE_EXHAUSTED message, both preserved here."""
+
+
+_StandInXlaRuntimeError.__name__ = "XlaRuntimeError"
+_StandInXlaRuntimeError.__qualname__ = "XlaRuntimeError"
+_OOM_TYPE: list = []  # resolved lazily; faults.py sits on the frame
+#                       import chain and must not pull jaxlib in early
+
+
+def _xla_runtime_error_type():
+    if not _OOM_TYPE:
+        try:
+            # the REAL runtime-error type XLA raises on device OOM — an
+            # ``oom`` fault is then type-identical to production, not
+            # just message-identical
+            from jaxlib.xla_extension import XlaRuntimeError
+            _OOM_TYPE.append(XlaRuntimeError)
+        # jaxlib absent/renamed: the message-compatible stand-in keeps
+        # the harness usable on host-only installs
+        except Exception:  # pragma: no cover - jaxlib absent/renamed
+            _OOM_TYPE.append(_StandInXlaRuntimeError)
+    return _OOM_TYPE[0]
+
+
+def oom_error(nbytes: int = 2 << 30, point: str = "") -> BaseException:
+    """One realistic device-OOM exception (the ``oom`` action's
+    payload), exactly message-shaped like a real allocator failure."""
+    suffix = f" [{point}]" if point else ""
+    return _xla_runtime_error_type()(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        f"{int(nbytes)} bytes.{suffix}")
 
 
 def _resolve_exc(name: str | None):
@@ -78,9 +120,10 @@ class _Rule:
         self.point = str(spec["point"])
         self.action = str(spec.get("action", "raise"))
         if self.action not in ("raise", "sigterm", "corrupt", "unlink",
-                               "delay"):
+                               "delay", "oom"):
             raise ValueError(f"unknown fault action {self.action!r}")
         self.seconds = float(spec.get("seconds", 0.0))
+        self.nbytes = int(spec.get("bytes", 0) or 0)  # oom: alloc size
         # triggers — all optional, all must match when present:
         self.at_call = spec.get("at_call")        # exactly the Nth call
         self.first_calls = spec.get("first_calls")  # calls 1..K
@@ -110,6 +153,8 @@ class _Rule:
                 d[k] = v
         if self.seconds:
             d["seconds"] = self.seconds
+        if self.nbytes:
+            d["bytes"] = self.nbytes
         if self.when:
             d["when"] = self.when
         return d
@@ -164,6 +209,16 @@ class FaultPlan:
         if first_calls is not None:
             rule["first_calls"] = int(first_calls)
         return cls([rule])
+
+    @classmethod
+    def oom(cls, point: str = "frame.dispatch", at_call: int = 1,
+            nbytes: int = 2 << 30) -> "FaultPlan":
+        """Raise a realistic ``XlaRuntimeError``-shaped
+        ``RESOURCE_EXHAUSTED`` at one firing of ``point`` — the
+        device-OOM recovery shape (the supervisor evicts unpinned HBM
+        entries and retries; FAULTS.md)."""
+        return cls([{"point": point, "action": "oom",
+                     "at_call": int(at_call), "bytes": int(nbytes)}])
 
     @classmethod
     def corrupt_on_read(cls, point: str = "shards.read",
@@ -223,6 +278,9 @@ class FaultPlan:
             # the executor, not the harness
             time.sleep(matched.seconds)
             return
+        if matched.action == "oom":
+            raise oom_error(matched.nbytes or (2 << 30),
+                            point=f"{point} call {matched.calls}")
         if matched.action == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
             return  # the handler decides what dies; the firing returns
