@@ -355,6 +355,22 @@ def _compact_summary(record: dict) -> dict:
             # on the virtual 8-device mesh (1.0 = the mesh fast path
             # costs nothing), and the SPMD padding waste
             s[k] = _scalar(ms[k])
+    cs = record.get("cold_start") or {}
+    for k in ("cold_start_speedup", "aot_programs_restored"):
+        if cs.get(k) is not None:
+            # the ISSUE-15 one-liners: second-process first-result over
+            # the empty-store arm, and how many serialized programs the
+            # warm arm restored before its first batch
+            s[k] = _scalar(cs[k])
+    snap = record.get("metrics_snapshot") or {}
+    for name, key in (("compile.hits", "compile_hits"),
+                      ("compile.misses", "compile_misses")):
+        v = (snap.get(name) or {}).get("value")
+        if v is not None:
+            # every round's judged line stamps the parent process's own
+            # AOT hit/miss counts — a round that silently stopped
+            # hitting the program store is visible on the one line
+            s[key] = _scalar(int(v))
     pre = record.get("preemption") or {}
     if pre.get("graceful_kill_rc") is not None:
         # the robustness one-liners (JOBS.md): graceful kill exits 75,
@@ -1777,6 +1793,151 @@ def measure_mesh_scaling():
     return out
 
 
+def _cold_start_program():
+    """The cold-start child's featurize-shaped program: a small conv
+    stack whose XLA compile is non-trivial (seconds on CPU, tens of
+    seconds through the tunnel) while its restore is a deserialization.
+    Deterministic seed → identical fn fingerprint in every child, so
+    the warm arm's store keys match the cold arm's."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    kernels = [rng.standard_normal((3, 3, c_in, c_out)).astype(
+        np.float32) * 0.1 for c_in, c_out in
+        [(3, 16), (16, 16), (16, 32), (32, 32), (32, 32), (32, 32)]]
+
+    def net(b):
+        x = b.astype(jnp.float32) / 255.0
+        for k in kernels:
+            x = jax.nn.relu(jax.lax.conv_general_dilated(
+                x, k, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        return x.mean(axis=(1, 2, 3))
+
+    return jax.jit(net)  # factory return: the child owns the program
+
+
+def run_cold_start_child(out_path):
+    """Subprocess body of the cold-start sub-bench (``bench.py
+    --cold-start-child``): measure FIRST-RESULT latency of a
+    featurize-shaped pipeline in this fresh process. The parent arms
+    ``TPUDL_COMPILE_AOT`` at either an empty store (arm A: the run
+    traces + compiles) or a warmed one (arm B: warm_start restores
+    serialized executables and the first dispatch hits). The clock
+    starts BEFORE jax import — first-result latency is a process-level
+    claim, exactly what a serving relaunch pays."""
+    t0 = time.perf_counter()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # never the tunneled TPU
+    from tpudl import compile as _compile, obs
+    from tpudl.frame import Frame
+
+    n = int(os.environ.get("TPUDL_BENCH_COLD_N", "256"))
+    batch = 64
+    restored = _compile.warm_start(block=True)  # before the first batch
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(n, 48, 48, 3), dtype=np.uint8)
+    frame = Frame({"x": x})
+    res = frame.map_batches(_cold_start_program(), ["x"], ["y"],
+                            batch_size=batch, autotune=False, aot=True)
+    np.asarray(res["y"])  # first (and only) result materialized
+    first_s = time.perf_counter() - t0
+    # persist the background-compiled programs before exit: the warm
+    # arm reads this store
+    _compile.get_program_store().drain(180)
+    snap = obs.snapshot()
+
+    def val(name):
+        return int(snap.get(name, {}).get("value") or 0)
+
+    with open(out_path, "w") as f:
+        json.dump({"first_result_s": round(first_s, 4),
+                   "aot_programs_restored": restored,
+                   "compile_hits": val("compile.hits"),
+                   "compile_misses": val("compile.misses")}, f)
+
+
+def measure_cold_start():
+    """cold-start sub-bench (COMPILE.md, ISSUE 15): subprocess A/B of
+    first-result latency with an EMPTY vs a WARMED AOT program store.
+    Both arms run with the jax persistent compilation cache DISABLED so
+    the A/B isolates the program store (arm A must really compile).
+    Emits ``cold_start_speedup`` (cold over warm — a within-round
+    ratio, scored raw by bench_sentinel like ``async_speedup``) and
+    ``aot_programs_restored`` onto the judged summary line; the store
+    the warm arm reads is audited by tools/validate_programs."""
+    import subprocess
+
+    me = os.path.abspath(__file__)
+    timeout = float(os.environ.get("TPUDL_BENCH_TRIAL_TIMEOUT_S", "450"))
+
+    def run_child(store_dir):
+        env = dict(os.environ)
+        env["TPUDL_COMPILE_AOT"] = store_dir
+        env["TPUDL_COMPILE_CACHE_DIR"] = "0"  # isolate the A/B
+        # platform pinned IN-PROCESS by the child (the mesh-child
+        # pattern: JAX_PLATFORMS=cpu in env hangs the axon image's
+        # preloaded-jax interpreter startup)
+        with tempfile.TemporaryDirectory(
+                prefix="tpudl-bench-cold-") as td:
+            out_path = os.path.join(td, "cold.json")
+            r = subprocess.run(
+                [sys.executable, me, "--cold-start-child", out_path],
+                capture_output=True, text=True, env=env,
+                timeout=timeout)
+            if r.returncode != 0 or not os.path.exists(out_path):
+                raise RuntimeError(
+                    f"cold-start child rc={r.returncode}: "
+                    f"{r.stderr[-400:]}")
+            with open(out_path) as f:
+                return json.load(f)
+
+    out = {}
+    with tempfile.TemporaryDirectory(prefix="tpudl-aot-") as warm_root:
+        warm_dir = os.path.join(warm_root, "store")
+        os.makedirs(warm_dir)
+        seed = run_child(warm_dir)  # populates the store (a cold run)
+        out["seed_first_result_s"] = seed["first_result_s"]
+        colds, warms = [], []
+        warm_last = None
+        for _t in range(2):  # interleaved A/B (the house discipline)
+            with tempfile.TemporaryDirectory(
+                    prefix="tpudl-aot-empty-") as empty:
+                colds.append(run_child(
+                    os.path.join(empty, "s"))["first_result_s"])
+            warm_last = run_child(warm_dir)
+            warms.append(warm_last["first_result_s"])
+        # the warmed store must audit clean (importable validator, the
+        # tier-1 contract) — a corrupt store invalidates the warm arm
+        sys.path.insert(0, os.path.join(os.path.dirname(me), "tools"))
+        from validate_programs import validate_store_dir
+
+        errs, n_entries, n_exe = validate_store_dir(warm_dir)
+        if errs:
+            raise RuntimeError(f"warm program store failed audit: "
+                               f"{errs[:3]}")
+        out["store_programs"] = n_entries
+        out["store_executables"] = n_exe
+    cold_s = statistics.median(colds)
+    warm_s = statistics.median(warms)
+    out["cold_first_result_s"] = round(cold_s, 4)
+    out["warm_first_result_s"] = round(warm_s, 4)
+    out["aot_programs_restored"] = int(
+        warm_last.get("aot_programs_restored") or 0)
+    out["warm_compile_hits"] = int(warm_last.get("compile_hits") or 0)
+    out["warm_compile_misses"] = int(
+        warm_last.get("compile_misses") or 0)
+    if warm_s > 0:
+        out["cold_start_speedup"] = round(cold_s / warm_s, 2)
+    log(f"cold start A/B: empty store {cold_s:.2f}s vs warmed "
+        f"{warm_s:.2f}s first-result -> "
+        f"{out.get('cold_start_speedup')}x "
+        f"({out['aot_programs_restored']} programs restored)")
+    return out
+
+
 def run_preemption_job(workdir, out_path, steps, save_every,
                        progress_path):
     """Subprocess body of the preemption sub-bench (``bench.py
@@ -2378,6 +2539,7 @@ def main():
                         ("async_dispatch", measure_async_dispatch),
                         ("fault_recovery", measure_fault_recovery),
                         ("mesh_scaling", measure_mesh_scaling),
+                        ("cold_start", measure_cold_start),
                         ("preemption", measure_preemption),
                         ("flash_attention", measure_flash_attention)]:
             if not _gate(extra, key):
@@ -2448,6 +2610,8 @@ if __name__ == "__main__":
         run_featurize_trial(arm, int(trial_n), int(trial_batch), trial_dtype)
     elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":
         run_mesh_child(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--cold-start-child":
+        run_cold_start_child(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--preemption-job":
         wd, outp, n_steps, save_ev, progp = sys.argv[2:7]
         run_preemption_job(wd, outp, int(n_steps), int(save_ev), progp)

@@ -33,6 +33,14 @@ echo "== chaos subset (fault-containment matrix, ISSUE 14 acceptance) =="
 # cannot replace the chaos marker and skip the matrix.
 python -m pytest tests/test_supervisor.py -q "$@" -m chaos
 
+echo "== compile subset (ISSUE 15: buckets + AOT store acceptance) =="
+# Target the compile module DIRECTLY (same rationale as the armed
+# concurrency subset above): the zero-retrace traceck sweep and the
+# kill-mid-precompile case run in subprocesses the tests spawn
+# themselves, and an unrelated jax-version collection error must not
+# mask a compile-subsystem regression under set -e.
+python -m pytest tests/test_compile.py -q "$@"
+
 echo "== virtual-mesh executor subset (ISSUE 11 acceptance) =="
 # Target the mesh-executor module DIRECTLY (same rationale as the
 # armed concurrency subset above): a jax-version collection error in

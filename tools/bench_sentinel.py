@@ -155,6 +155,17 @@ METRICS = [
            keys=[("device_cache", "hbm_warm_speedup")],
            tail_patterns=[r'"hbm_warm_speedup": ' + _NUM],
            wire_sensitive=False, floor=0.30),
+    # cold start: a within-round ratio (empty-program-store first-
+    # result over warmed-store first-result, identical child program,
+    # persistent XLA cache disabled in both arms) — scored raw like
+    # async_speedup. A drop means the AOT store stopped restoring
+    # (serialize/deserialize breakage, fingerprint churn re-keying
+    # every process, manifest corruption) — a compile-subsystem
+    # regression, never weather.
+    Metric("cold_start_speedup",
+           keys=[("cold_start", "cold_start_speedup")],
+           tail_patterns=[r'"cold_start_speedup": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
     # fault-recovery: a within-round ratio (clean wall over
     # recovered-from-one-injected-fault wall, same program/rows — the
     # higher-is-better twin of degraded_recovery_overhead_pct on the

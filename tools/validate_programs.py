@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Integrity audit for tpudl.compile AOT program-store directories.
+
+The seventh validator (house convention, like validate_shards /
+validate_job — importable + CLI, tier-1-wired by tests/test_compile.py):
+given a store directory it checks
+
+- the manifest schema (``programs-manifest.json``: schema/version/
+  entries object, per-entry required keys and types);
+- every entry's self-checksum (crc32 over its canonical JSON — a torn
+  or hand-edited entry never silently feeds a restore);
+- every referenced serialized executable (existence, byte size, crc32);
+- shapes↔bucket-ladder consistency: an entry marked ``bucketed`` must
+  have a leading dim that IS a ladder rung (the manifest records the
+  ladder it was observed under);
+- the stale-executable audit: a ``prog-*.bin`` on disk that no entry
+  references is leftover garbage from a dead manifest generation
+  (kill-mid-precompile leaves none — writes are atomic — so a stale
+  file means a foreign/hand-rolled store).
+
+Exit 0 = intact, 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # direct-script CLI: repo on path
+
+# the ONE authority for manifest constants and the entry/file checksum
+# rules — a validator keeping stale copies would flag every healthy
+# store the moment store.py's canonicalization moved (tpudl.compile
+# imports no jax at module level, so the CLI stays light)
+from tpudl.compile.store import (EXE_PREFIX, MANIFEST_NAME,  # noqa: E402
+                                 MANIFEST_SCHEMA, MANIFEST_VERSION,
+                                 _crc32_file, _entry_crc)
+
+_ENTRY_KEYS = {"fn": str, "tree": str, "leaves": list, "donate": bool,
+               "portable": bool, "bucketed": bool, "created_ts": float,
+               "crc": int}
+
+
+def _ladder(meta):
+    """The manifest's declared ladder as a pick() callable, or None."""
+    if not isinstance(meta, dict):
+        return None
+    try:
+        from tpudl.compile.buckets import BucketLadder
+
+        if meta.get("rungs"):
+            return BucketLadder(rungs=meta["rungs"])
+        return BucketLadder(str(meta.get("spec")))
+    except Exception:
+        return None
+
+
+def validate_store_dir(root: str) -> tuple[list[str], int, int]:
+    """(errors, n_entries, n_executables) for one store directory."""
+    errs: list[str] = []
+    path = os.path.join(root, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except FileNotFoundError:
+        return [f"{root}: no {MANIFEST_NAME}"], 0, 0
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable manifest ({e})"], 0, 0
+    if not isinstance(m, dict):
+        return [f"{path}: manifest is not a JSON object"], 0, 0
+    if m.get("schema") != MANIFEST_SCHEMA:
+        errs.append(f"{path}: schema {m.get('schema')!r} != "
+                    f"{MANIFEST_SCHEMA!r}")
+    if m.get("version") != MANIFEST_VERSION:
+        errs.append(f"{path}: version {m.get('version')!r} != "
+                    f"{MANIFEST_VERSION}")
+    entries = m.get("entries")
+    if not isinstance(entries, dict):
+        return errs + [f"{path}: entries missing or not an object"], 0, 0
+    ladder = _ladder(m.get("ladder"))
+    referenced: set[str] = set()
+    n_exe = 0
+    for key in sorted(entries):
+        entry = entries[key]
+        where = f"{path}: entry {key[:12]}"
+        if not isinstance(entry, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        bad = False
+        for fk, ft in _ENTRY_KEYS.items():
+            v = entry.get(fk)
+            ok = isinstance(v, ft) or (fk == "created_ts"
+                                       and isinstance(v, int))
+            if not ok:
+                errs.append(f"{where}: key {fk!r} missing or not "
+                            f"{ft.__name__}")
+                bad = True
+        if bad:
+            continue
+        if _entry_crc(entry) != entry["crc"]:
+            errs.append(f"{where}: entry checksum mismatch (torn or "
+                        f"edited manifest entry)")
+            continue
+        leaves = entry["leaves"]
+        if not all(isinstance(lf, list) and len(lf) == 3
+                   and isinstance(lf[0], list) for lf in leaves):
+            errs.append(f"{where}: leaves must be [shape, dtype, "
+                        f"sharding] triples")
+            continue
+        if entry["bucketed"] and ladder is not None and leaves \
+                and leaves[0][0]:
+            lead = int(leaves[0][0][0])
+            if not ladder.is_rung(lead):
+                errs.append(
+                    f"{where}: bucketed entry's leading dim {lead} is "
+                    f"not a rung of the declared "
+                    f"{m.get('ladder')} ladder")
+        exe = entry.get("exe")
+        if exe is None:
+            continue
+        n_exe += 1
+        referenced.add(str(exe))
+        epath = os.path.join(root, str(exe))
+        try:
+            size = os.stat(epath).st_size
+        except OSError:
+            errs.append(f"{where}: missing executable {exe}")
+            continue
+        if size != entry.get("exe_nbytes"):
+            errs.append(f"{where}: {exe} size {size} != manifest "
+                        f"{entry.get('exe_nbytes')} (truncated?)")
+            continue
+        if _crc32_file(epath) != entry.get("exe_crc32"):
+            errs.append(f"{where}: {exe} crc32 mismatch")
+    # stale-executable audit: on-disk binaries no entry references. A
+    # bin whose KEY has an entry still reading exe=null is a crashed
+    # in-flight persist (bin published, manifest seal lost) — benign:
+    # the next store open sweeps it and the next persist overwrites it.
+    # A bin with NO entry at all is foreign garbage.
+    try:
+        for name in sorted(os.listdir(root)):
+            if not (name.startswith(EXE_PREFIX) and name.endswith(".bin")
+                    and name not in referenced):
+                continue
+            key = name[len(EXE_PREFIX):-len(".bin")]
+            entry = entries.get(key)
+            if isinstance(entry, dict) and entry.get("exe") is None:
+                continue  # in-flight/crashed persist: not an error
+            errs.append(f"{root}: stale executable {name} "
+                        f"(no manifest entry references it)")
+    except OSError as e:
+        errs.append(f"{root}: unreadable ({e})")
+    return errs, len(entries), n_exe
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: validate_programs.py <store_dir>", file=sys.stderr)
+        return 2
+    errors, n_entries, n_exe = validate_store_dir(argv[1])
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    print(f"{argv[1]}: {n_entries} programs, {n_exe} executables, "
+          f"{'OK' if not errors else str(len(errors)) + ' errors'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

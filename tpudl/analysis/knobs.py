@@ -31,7 +31,8 @@ class Knob:
     name: str        # the full TPUDL_* env var
     kind: str        # int | float | bool | str | enum | path | json
     default: str     # rendered default ("" = unset / derived)
-    subsystem: str   # frame | data | obs | jobs | train | zoo | bench
+    subsystem: str   # frame | data | obs | jobs | train | zoo |
+                     # compile | bench
     help: str        # one line, present tense
 
 
@@ -155,7 +156,7 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_TSAN_DEADLOCK_S", "float", "10", "jobs",
          "armed-acquisition wait slice before the sanitizer walks the "
          "wait-for graph for a deadlock cycle"),
-    # -- zoo / compile cache -------------------------------------------
+    # -- zoo -----------------------------------------------------------
     Knob("TPUDL_WEIGHTS_DIR", "path", "", "zoo",
          "offline pretrained-weights directory (<model>.npz artifacts)"),
     Knob("TPUDL_IMAGENET_CLASS_INDEX", "path", "", "zoo",
@@ -163,9 +164,22 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_S2D_STEM", "bool", "0", "zoo",
          "1 enables the space-to-depth conv stem (defaults OFF: slower "
          "on this backend, see zoo/s2d.py)"),
+    # -- compile subsystem (COMPILE.md) --------------------------------
     Knob("TPUDL_COMPILE_CACHE_DIR", "path",
-         "~/.cache/tpudl/xla_cache", "zoo",
-         "persistent XLA compilation cache directory (0 disables)"),
+         "~/.cache/tpudl/xla_cache", "compile",
+         "persistent XLA compilation cache directory (0 disables, "
+         "loudly: warn-once + compile.cache_disabled)"),
+    Knob("TPUDL_COMPILE_AOT", "str", "", "compile",
+         "arms the AOT program store: 1 = on at "
+         "<compile cache dir>/programs, a path = on at that "
+         "directory, unset/0 = off. Dispatch consults precompiled "
+         "executables; misses background-compile + persist for the "
+         "next process"),
+    Knob("TPUDL_COMPILE_BUCKETS", "str", "", "compile",
+         "shape-bucket ladder: pow2 | pow2ish (also 1/auto) | an "
+         "explicit comma list of rungs | unset/0 = off. Ragged "
+         "dispatch shapes pad to the nearest rung so the workload "
+         "runs through O(log n) compiled programs"),
     # -- bench (bench.py header) ---------------------------------------
     Knob("TPUDL_BENCH_BUDGET_S", "float", "2400", "bench",
          "soft wall-clock budget; remaining sub-benches skip past it"),
@@ -236,6 +250,9 @@ KNOBS: tuple[Knob, ...] = (
          "bench", "flash-attention sub-bench sequence-length ladder"),
     Knob("TPUDL_BENCH_PREEMPT_STEPS", "int", "300", "bench",
          "preemption sub-bench child-job step count"),
+    Knob("TPUDL_BENCH_COLD_N", "int", "256", "bench",
+         "cold-start sub-bench row count (empty- vs warmed-program-"
+         "store first-result subprocess A/B)"),
 )
 
 KNOB_NAMES = frozenset(k.name for k in KNOBS)

@@ -206,6 +206,41 @@ METRICS: tuple[Metric, ...] = (
     Metric("traceck.storms", "counter",
            "armed sentinel: identities tracing past "
            "TPUDL_TRACECK_STORM (one recompile-storm finding each)"),
+    # -- compile subsystem (COMPILE.md) --------------------------------
+    Metric("compile.hits", "counter",
+           "AOT program-store dispatch hits (precompiled/restored "
+           "executable ran — no trace possible)"),
+    Metric("compile.misses", "counter",
+           "AOT program-store dispatch misses (jitted path ran; "
+           "signature recorded + background-compiled)"),
+    Metric("compile.aot_s", "counter",
+           "seconds spent AOT-compiling, serializing and restoring "
+           "programs (off the dispatch hot path)"),
+    Metric("compile.bucket_pad_rows", "counter",
+           "rows of bucket-ladder padding shipped and stripped "
+           "(the price of O(log n) program signatures)"),
+    Metric("compile.observed", "counter",
+           "novel program signatures recorded into the manifest"),
+    Metric("compile.programs_compiled", "counter",
+           "programs AOT-compiled (warmup + background misses)"),
+    Metric("compile.programs_restored", "counter",
+           "serialized executables deserialized into the program "
+           "table at process start (the zero-cold-start path)"),
+    Metric("compile.serialize_failed", "counter",
+           "programs whose executable could not be serialized "
+           "(table-only for this process; a restart re-lowers them)"),
+    Metric("compile.deserialize_failed", "counter",
+           "persisted executables that failed to deserialize "
+           "(skipped; the jit path covers them)"),
+    Metric("compile.exec_failed", "counter",
+           "table hits whose executable refused its args (dropped; "
+           "fell back to the jitted path)"),
+    Metric("compile.store_corrupt", "counter",
+           "corrupt program-store artifacts quarantined (manifest or "
+           "executable checksum)"),
+    Metric("compile.cache_disabled", "counter",
+           "persistent-compilation-cache setup failures (a cold fleet "
+           "is diagnosable: warn-once + flight breadcrumb ride along)"),
     Metric("obs.roofline.achieved_rows_per_s", "gauge",
            "measured end-to-end throughput (roofline input)"),
     Metric("obs.roofline.achievable_rows_per_s", "gauge",

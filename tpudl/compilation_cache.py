@@ -1,44 +1,10 @@
-"""Persistent XLA compilation cache wiring.
-
-The reference pays Spark task-dispatch overhead per stage; our analogous
-fixed cost is XLA compilation — ~60-200 s for InceptionV3 through a
-tunneled dev chip, paid again every process start. JAX's persistent
-compilation cache (serialized executables keyed by HLO+flags+topology)
-removes it for repeat runs. This module turns it on with sane defaults;
-it is enabled automatically by ``bench.py`` and opt-in elsewhere via
-``TPUDL_COMPILE_CACHE_DIR`` (set to a directory, or ``0`` to disable).
-
-Cache safety: entries are keyed by backend+topology, so a cache shared
-between the CPU-mesh test runs and the TPU chip never cross-serves.
-"""
+"""Back-compat shim: the compilation cache grew into the
+:mod:`tpudl.compile` subsystem (COMPILE.md) — persistent XLA cache +
+AOT program store + shape bucketing. Import from ``tpudl.compile``;
+this module keeps the original spelling working."""
 
 from __future__ import annotations
 
-import os
+from tpudl.compile.cache import enable_compilation_cache
 
 __all__ = ["enable_compilation_cache"]
-
-_DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache", "tpudl",
-                            "xla_cache")
-
-
-def enable_compilation_cache(path: str | None = None) -> str | None:
-    """Enable JAX's persistent compilation cache at ``path`` (default:
-    ``$TPUDL_COMPILE_CACHE_DIR`` or ``~/.cache/tpudl/xla_cache``).
-    Returns the cache dir, or None when disabled/unsupported."""
-    env = os.environ.get("TPUDL_COMPILE_CACHE_DIR")
-    if env == "0":
-        return None
-    path = path or env or _DEFAULT_DIR
-    try:
-        import jax
-
-        os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", path)
-        # cache everything that took meaningful compile time; tiny
-        # programs aren't worth the disk round-trip
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        return path
-    except Exception:  # pragma: no cover - old jax or read-only fs
-        return None

@@ -1,0 +1,97 @@
+"""Persistent XLA compilation cache wiring (the bottom tier of the
+compile subsystem — COMPILE.md has the operator guide).
+
+The reference pays Spark task-dispatch overhead per stage; our analogous
+fixed cost is XLA compilation — ~60-200 s for InceptionV3 through a
+tunneled dev chip, paid again every process start. JAX's persistent
+compilation cache (serialized executables keyed by HLO+flags+topology)
+removes the *compile* for repeat runs; the AOT program store
+(:mod:`tpudl.compile.store`) sits above it and removes the *trace* too.
+This module turns the JAX cache on with sane defaults; it is enabled
+automatically by ``bench.py`` and opt-in elsewhere via
+``TPUDL_COMPILE_CACHE_DIR`` (set to a directory, or ``0`` to disable).
+
+Cache safety: entries are keyed by backend+topology, so a cache shared
+between the CPU-mesh test runs and the TPU chip never cross-serves.
+
+Failure is LOUD: a read-only filesystem or an old jax without the
+config surface used to be swallowed silently — a whole fleet could cold
+start on every process with nothing in any log. Now the first failure
+warns once per process, counts ``compile.cache_disabled``, and files a
+flight-recorder breadcrumb, so ``python -m tpudl.obs doctor`` and the
+metrics sink both show WHY the fleet is cold.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+__all__ = ["enable_compilation_cache", "DEFAULT_CACHE_DIR"]
+
+DEFAULT_CACHE_DIR = os.path.join(os.path.expanduser("~"), ".cache",
+                                 "tpudl", "xla_cache")
+
+_warned_disabled = False
+
+
+def _note_disabled(path: str, exc: BaseException) -> None:
+    """The diagnosable-cold-fleet breadcrumb: warn once per process,
+    count every occurrence, leave flight evidence (all best-effort —
+    cache setup must never take the run down)."""
+    global _warned_disabled
+    try:
+        from tpudl.obs import metrics as _m
+
+        _m.counter("compile.cache_disabled").inc()
+        from tpudl.obs import flight as _flight
+
+        _flight.record_error(
+            "compile.cache_disabled",
+            f"persistent compilation cache disabled at {path!r}: "
+            f"{exc!r} — every process start pays full XLA compile",
+            path=path)
+    # tpudl: ignore[swallowed-except] — the breadcrumb channel itself
+    # is best-effort: obs may be unimportable in a minimal subprocess,
+    # and the warning below still fires
+    except Exception:
+        pass
+    if not _warned_disabled:
+        _warned_disabled = True
+        warnings.warn(
+            f"tpudl: persistent XLA compilation cache DISABLED "
+            f"({path!r}: {exc!r}) — cold starts will pay full compile "
+            f"time; fix the directory or set TPUDL_COMPILE_CACHE_DIR",
+            RuntimeWarning, stacklevel=3)
+
+
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Enable JAX's persistent compilation cache at ``path`` (default:
+    ``$TPUDL_COMPILE_CACHE_DIR`` or ``~/.cache/tpudl/xla_cache``).
+    Returns the cache dir, or None when disabled/unsupported.
+    Precedence: ``TPUDL_COMPILE_CACHE_DIR=0`` kills the cache outright
+    (even against an explicit ``path`` — the operator's emergency
+    switch), else an explicit ``path`` beats the env beats the
+    default."""
+    env = os.environ.get("TPUDL_COMPILE_CACHE_DIR")
+    if env == "0":
+        return None
+    path = path or env or DEFAULT_CACHE_DIR
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything that took meaningful compile time; tiny
+        # programs aren't worth the disk round-trip
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
+    except Exception as e:  # old jax or read-only fs: loud, never fatal
+        _note_disabled(str(path), e)
+        return None
+
+
+def _reset_warned_for_tests() -> None:
+    global _warned_disabled
+    _warned_disabled = False

@@ -677,6 +677,25 @@ class Frame:
           residency forces ``fuse_steps`` to 1: fusion amortizes the
           per-dispatch round-trip by re-stacking HOST batches, which
           would defeat the residency it rides with. Device fns only.
+        The ``tpudl.compile`` knobs (COMPILE.md):
+
+        - ``buckets`` (env ``TPUDL_COMPILE_BUCKETS``, default off): a
+          bucket-ladder spec (``"pow2"``, ``"pow2ish"``/``"1"``, an
+          explicit ``"8,16,32"`` list, or a
+          :class:`tpudl.compile.BucketLadder`). Ragged dispatch shapes
+          pad up to the smallest ladder rung (repeating row 0, pad
+          rows stripped from the outputs — the mesh-pad discipline),
+          so an arbitrary mix of batch sizes runs through O(log n)
+          compiled programs instead of one retrace per novel shape.
+          If the primary ``batch_size`` itself is not a rung, fusion
+          drops to per-batch dispatch (a fused stack would interleave
+          the pad rows).
+        - ``aot`` (env ``TPUDL_COMPILE_AOT``, default off): consult
+          the AOT program store at dispatch — a hit executes a
+          precompiled executable (restored from disk on process start:
+          zero trace, zero compile); a miss runs the jitted path
+          unchanged and background-compiles the signature so the NEXT
+          process starts warm. ``compile.{hits,misses}`` count both.
         ``supervise`` (env ``TPUDL_FRAME_DEGRADE``, default OFF): arm
         the fault-containment supervisor (FAULTS.md,
         :mod:`tpudl.frame.supervisor`). Classified executor faults
@@ -732,6 +751,8 @@ class Frame:
         cache_dir: str | None = None,
         cache_key: str | None = None,
         device_cache: bool | None = None,
+        buckets=None,
+        aot: bool | None = None,
         _supervisor=None,
     ) -> "Frame":
         """One executor attempt: the full staged pipeline (the
@@ -982,6 +1003,45 @@ class Frame:
                         # contract — same rule as the mesh gate)
                         seeded.remove("fuse_steps")
 
+        # -- tpudl.compile: shape buckets + AOT program store -------------
+        # (COMPILE.md; PIPELINE.md "Bucket pick & AOT dispatch".) The
+        # ladder snaps ragged dispatch shapes onto O(log n) rungs; the
+        # program store serves precompiled executables at dispatch and
+        # records misses for the next process. Both are opt-in
+        # (TPUDL_COMPILE_BUCKETS / TPUDL_COMPILE_AOT or the kwargs),
+        # device fns only, and the serial kill switch disarms them like
+        # every other fast-path stage.
+        ladder = None
+        store = None
+        if device_fn_real and not killed:
+            from tpudl.compile import buckets as _bk
+
+            ladder = _bk.resolve_ladder(buckets)
+            from tpudl.compile import store as _aot_store
+
+            if _aot_store.aot_enabled(aot):
+                store = _aot_store.get_program_store()
+                # fresh-process warm start: deserialize persisted
+                # executables on the background pool so the first
+                # batches can already hit (idempotent per process)
+                store.ensure_restored()
+                store.note_ladder(ladder)
+        bucket_full = False
+        if ladder is not None:
+            # does the PRIMARY batch size itself snap to a rung? If it
+            # pads, every full batch carries pad rows — and a fused
+            # (m, B, ...) stack would interleave them in the flattened
+            # output (the same rule that gates mesh fusion), so fusion
+            # drops to per-batch dispatch
+            target_full = ladder.pick(int(batch_size))
+            if mesh is not None:
+                target_full = -(-target_full // multiple) * multiple
+            bucket_full = target_full != int(batch_size)
+            if bucket_full and fuse > 1:
+                fuse = 1
+                if "fuse_steps" in seeded:
+                    seeded.remove("fuse_steps")
+
         report.config = {
             "executor": ("pipelined" if (prefetch or fuse > 1
                                          or d_depth > 1)
@@ -1005,6 +1065,12 @@ class Frame:
                            else "off"),
             "batch_cache": bool(cache is not None),
             "device_cache": bool(dcache is not None),
+            # tpudl.compile (COMPILE.md): the bucket ladder in force
+            # ("off" = exact shapes) and whether dispatch consults the
+            # AOT program store — the roofline's cold-start attribution
+            # and the live monitor's compile line both read these
+            "buckets": ladder.spec if ladder is not None else "off",
+            "aot": bool(store is not None),
         }
         obs.set_last_pipeline(report)
         if _supervisor is not None:
@@ -1153,17 +1219,36 @@ class Frame:
                                      cache_hit=cache_hit,
                                      run=report.run_id)
                 n_pad = 0
+                if ladder is not None and packed:
+                    # bucket pick (COMPILE.md): snap this batch's
+                    # dispatch shape onto the ladder — pad rows repeat
+                    # row 0 (the mesh.pad_batch discipline) and are
+                    # stripped from the outputs via the same n_pad
+                    # plumbing the mesh path uses, so values for real
+                    # rows are bitwise-identical to exact dispatch.
+                    # Under a mesh the rung rounds up to the data-axis
+                    # multiple so SPMD padding never pads twice.
+                    rows_b = int(packed[0].shape[0])
+                    target = ladder.pick(rows_b)
+                    if mesh is not None:
+                        target = -(-target // multiple) * multiple
+                    if target > rows_b:
+                        packed = [_bk.pad_to(a, target) for a in packed]
+                        n_pad = target - rows_b
+                        report.count("bucket_pad_rows", n_pad)
+                        _bk.count_pad_rows(n_pad)
                 if mesh is not None:
                     # every column slices the same rows, so one pad count
                     # serves
                     with report.stage("h2d"):
                         _faults.fire("frame.h2d", index=bidx)
                         padded = [M.pad_batch(arr, multiple) for arr in packed]
-                        n_pad = padded[0][1] if padded else 0
+                        mesh_pad = padded[0][1] if padded else 0
                         packed = [p for p, _ in padded]
-                        if n_pad:
-                            report.count("pad_rows", n_pad)
-                        report.gauge("mesh_pad_rows", n_pad)
+                        if mesh_pad:
+                            report.count("pad_rows", mesh_pad)
+                        report.gauge("mesh_pad_rows", mesh_pad)
+                        n_pad += mesh_pad
                         if transfer_in_prepare:
                             # ONE batched ASYNC device_put for every
                             # column (mesh.transfer_batch) — no barrier:
@@ -1354,7 +1439,14 @@ class Frame:
         window = (_DispatchWindow(d_depth, report) if d_depth > 1
                   else None)
 
-        def dispatch(call_fn, args, idx, n_pad, fused=False, pin=None):
+        # the roofline's cold-start evidence: the first dispatch's wall
+        # time (trace + compile ride inside it on a cold process); the
+        # flag list keeps the record single-shot (the first dispatch
+        # runs alone — window warmup — so no second writer races it)
+        first_dispatched: list = []
+
+        def dispatch(call_fn, args, idx, n_pad, fused=False, pin=None,
+                     donate_key=False):
             """Issue one dispatch: directly on the consumer (serial /
             depth 1) or onto the in-flight window. The dispatch stage
             itself — fault point, fn call, and starting the outputs'
@@ -1380,9 +1472,33 @@ class Frame:
                             call_args = M.transfer_batch(
                                 list(call_args), mesh,
                                 batch_dim=1 if fused else 0)
+                    t_disp = time.perf_counter()
                     with report.stage("dispatch"):
                         _faults.fire("frame.dispatch", index=idx)
-                        result = call_fn(*call_args)
+                        if store is not None:
+                            # AOT program store (COMPILE.md): a hit
+                            # executes a precompiled (possibly
+                            # restored-from-disk) program — no trace
+                            # possible; a miss runs the jitted path
+                            # unchanged and background-compiles the
+                            # signature for the next process. Only
+                            # pure-rung per-batch shapes are marked
+                            # bucketed (the validator's shapes↔ladder
+                            # audit): a fused stack leads with M, and
+                            # a mesh target rounds the rung up to the
+                            # data-axis multiple.
+                            result = store.call(
+                                call_fn, call_args, donate=donate_key,
+                                bucketed=(ladder is not None
+                                          and not fused
+                                          and mesh is None),
+                                report=report)
+                        else:
+                            result = call_fn(*call_args)
+                    if not first_dispatched:
+                        first_dispatched.append(True)
+                        report.count("first_dispatch_s",
+                                     time.perf_counter() - t_disp)
                     if not isinstance(result, (tuple, list)):
                         result = (result,)
                     # D2H starts NOW, at dispatch, for both outfeed
@@ -1428,13 +1544,18 @@ class Frame:
                             # group per-batch
                             for packed, n_pad, pin in group:
                                 dispatch(_run_fn_direct(), packed,
-                                         consumed, n_pad, pin=pin)
+                                         consumed, n_pad, pin=pin,
+                                         donate_key=(donate_flag
+                                                     and plan
+                                                     is not None))
                             continue
                         fused_fn = _fused_wrapper(
                             _run_fn(), fuse, n_args=len(input_cols),
                             donate=donate_flag)
                         dispatch(fused_fn, stacked, consumed, 0,
-                                 fused=True)
+                                 fused=True,
+                                 donate_key=bool(donate_flag
+                                                 and input_cols))
                     else:
                         packed, n_pad, pin = next_prepared()
                         if pin is not None:
@@ -1452,7 +1573,9 @@ class Frame:
                                      n_pad, pin=pin)
                         else:
                             dispatch(_run_fn_direct(), packed,
-                                     consumed, n_pad)
+                                     consumed, n_pad,
+                                     donate_key=(donate_flag
+                                                 and plan is not None))
                 while window is not None and len(window):
                     handle(*window.pop())
             finally:

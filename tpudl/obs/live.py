@@ -54,7 +54,7 @@ STATUS_PREFIX = "tpudl-status-"
 _METRIC_PREFIXES = ("train.", "hpo.", "udf.", "estimator.",
                     "obs.watchdog.", "obs.roofline.",
                     "frame.map_batches.", "frame.degraded.", "retry.",
-                    "data.hbm.")
+                    "data.hbm.", "compile.")
 
 
 def _status_dir() -> str | None:
@@ -168,6 +168,9 @@ def collect_status(roofline: bool = True) -> dict:
         hbm = _hbm_section(payload["metrics"], payload["ts"])
         if hbm is not None:
             payload["hbm"] = hbm
+        comp = _compile_section(payload["metrics"])
+        if comp is not None:
+            payload["compile"] = comp
     # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
     # contributor drops its section, never the whole status file
     except Exception:
@@ -214,6 +217,35 @@ def _hbm_section(metrics: dict, now: float) -> dict | None:
         out["hits_per_s"] = round(
             max(0.0, hits - prev[1]) / (now - prev[0]), 1)
     return out
+
+
+def _compile_section(metrics: dict) -> dict | None:
+    """The status file's compile line (ISSUE 15): AOT program-store
+    hit rate, programs restored/compiled, seconds spent in AOT work,
+    bucket pad rows, and whether the persistent cache ever disabled —
+    a fleet cold-starting (misses climbing, nothing restored, or
+    cache_disabled > 0) is visible LIVE. None when no compile metric
+    ever published in this process."""
+    def val(name):
+        entry = metrics.get(name) or {}
+        v = entry.get("value")
+        return v if isinstance(v, (int, float)) else None
+
+    hits = val("compile.hits")
+    misses = val("compile.misses")
+    if hits is None and misses is None \
+            and val("compile.programs_restored") is None \
+            and val("compile.cache_disabled") is None:
+        return None
+    return {
+        "hits": int(hits or 0),
+        "misses": int(misses or 0),
+        "programs_restored": int(val("compile.programs_restored") or 0),
+        "programs_compiled": int(val("compile.programs_compiled") or 0),
+        "aot_s": round(val("compile.aot_s") or 0.0, 3),
+        "bucket_pad_rows": int(val("compile.bucket_pad_rows") or 0),
+        "cache_disabled": int(val("compile.cache_disabled") or 0),
+    }
 
 
 def write_status(status_dir: str | None = None,
@@ -460,6 +492,22 @@ def render(statuses: list[dict], now: float | None = None) -> str:
                 line += f" ({rate:.1f}/s)"
             if hbm.get("evictions"):
                 line += f"  evictions {hbm['evictions']}"
+            lines.append(line)
+        comp = st.get("compile") or {}
+        if comp:
+            line = (f"  compile:    hits {comp.get('hits', 0)}"
+                    f"  misses {comp.get('misses', 0)}")
+            if comp.get("programs_restored"):
+                line += f"  restored {comp['programs_restored']}"
+            if comp.get("programs_compiled"):
+                line += f"  aot {comp['programs_compiled']}"
+            if comp.get("aot_s"):
+                line += f" ({comp['aot_s']:.1f}s)"
+            if comp.get("bucket_pad_rows"):
+                line += f"  pad_rows {comp['bucket_pad_rows']}"
+            if comp.get("cache_disabled"):
+                line += (f"  CACHE-DISABLED "
+                         f"x{comp['cache_disabled']}")
             lines.append(line)
         rl = st.get("roofline") or {}
         if rl.get("verdict"):

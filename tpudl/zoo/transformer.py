@@ -74,6 +74,16 @@ class TinyCausalLM:
         # compiled generate() programs keyed by static decode geometry
         # (a fresh jax.jit per call would retrace every time)
         self._gen_jits: dict = {}
+        # cross-process program identity for the AOT store (COMPILE.md):
+        # the generate program's FUNCTION closes over this model object,
+        # whose default repr carries a memory address — the token makes
+        # the fingerprint architecture-determined instead. Weights are
+        # ARGUMENTS (shapes in the signature, values at call time), so
+        # a serialized executable is valid for any params of this
+        # architecture.
+        self.aot_token = (f"TinyCausalLM:v{vocab}:d{dim}:h{heads}:"
+                          f"l{layers}:m{max_len}:e{experts}:"
+                          f"c{capacity_factor}")
 
     # -- params -----------------------------------------------------------
     def init(self, seed: int = 0) -> dict:
@@ -443,41 +453,31 @@ class TinyCausalLM:
         x = _layer_norm(x[:, 0], params["final_norm"])
         return x @ params["embed"]["table"].T, new_cache
 
-    def generate(self, params, prompt, max_new: int, *,
-                 temperature: float = 0.0, rng=None):
-        """Autoregressive continuation: ``prompt`` [B, P] int32 →
-        [B, max_new] int32. One jitted program: prefill scans
-        :meth:`decode_step` over the prompt (filling the cache),
-        generation scans it over ``max_new`` steps feeding each
-        prediction back in. ``temperature=0`` is greedy argmax;
-        otherwise softmax sampling with ``rng`` (a jax PRNG key).
-        Total length must fit ``max_len``."""
-        prompt = jnp.asarray(prompt, jnp.int32)
-        b, plen = prompt.shape
-        total = plen + max_new
-        if total > self.max_len:
-            raise ValueError(f"prompt {plen} + max_new {max_new} exceeds "
-                             f"max_len {self.max_len}")
-        if max_new < 1:
-            raise ValueError(f"max_new must be >= 1, got {max_new}")
-        if plen < 1:
-            # an empty prompt makes the prefill scan a no-op: the first
-            # token would be picked from the zero-initialized logits
-            # carry (always argmax of zeros), never from the model
-            raise ValueError(f"prompt must hold >= 1 token, got shape "
-                             f"{tuple(prompt.shape)}")
-        if temperature > 0 and rng is None:
-            raise ValueError("sampling (temperature > 0) needs rng=")
+    def _gen_program(self, b: int, plen: int, max_new: int,
+                     temperature: float):
+        """The jitted generate program for one static geometry
+        ``(batch, PADDED prompt len, max_new, temperature)`` — the real
+        prompt length is a TRACED argument, so every prompt that pads
+        up to the same bucket rung shares ONE compiled program
+        (COMPILE.md "LM sequence bucketing"; the prefill scan runs over
+        the padded length and the logits carry selects position
+        ``plen-1``, and the attention mask in :meth:`decode_step` — keys
+        ≤ pos — plus generation's in-place overwrites at plen, plen+1, …
+        guarantee a pad slot is never attended before it is
+        overwritten, so real-token results match exact-length dispatch;
+        only float reduction tiling over the longer masked cache can
+        differ, the DATA.md reassociation caveat class)."""
 
-        def run(params, prompt, key):
+        def run(params, prompt, key, real_plen):
             def prefill_step(carry, t):
-                cache, _ = carry
+                cache, best = carry
                 pos, tok = t
                 logits, cache = self.decode_step(params, tok, cache, pos)
-                # logits ride the CARRY (only the last position's are
-                # used) — a stacked scan output would materialize
+                # logits ride the CARRY (only position real_plen-1's
+                # are used) — a stacked scan output would materialize
                 # [plen, B, vocab]
-                return (cache, logits), None
+                best = jnp.where(pos == real_plen - 1, logits, best)
+                return (cache, best), None
 
             def pick(logits, step_key):
                 if temperature > 0:
@@ -495,7 +495,7 @@ class TinyCausalLM:
 
             # cache dtype follows the params (bf16 serving works)
             cache = self.init_cache(
-                b, total, dtype=params["embed"]["table"].dtype)
+                b, plen + max_new, dtype=params["embed"]["table"].dtype)
             (cache, logits), _ = jax.lax.scan(
                 prefill_step,
                 (cache, jnp.zeros((b, self.vocab),
@@ -508,10 +508,9 @@ class TinyCausalLM:
                 jnp.arange(1, max_new))
             (_c, _t), rest = jax.lax.scan(
                 gen_step, (cache, first),
-                (plen + jnp.arange(max_new - 1), keys))
+                (real_plen + jnp.arange(max_new - 1), keys))
             return jnp.concatenate([first[:, None], rest.T], axis=1)
 
-        key = rng if rng is not None else jax.random.PRNGKey(0)
         jit_key = (b, plen, max_new, float(temperature))
         fn = self._gen_jits.get(jit_key)
         if fn is None:
@@ -521,7 +520,103 @@ class TinyCausalLM:
                 # forever); FIFO eviction is fine at this size
                 self._gen_jits.pop(next(iter(self._gen_jits)))
             fn = self._gen_jits[jit_key] = jax.jit(run)
-        return fn(params, prompt, key)
+        return fn
+
+    def _gen_bucket(self, plen: int, max_new: int, prompt_buckets):
+        """Padded prompt length for this call: the smallest ladder rung
+        ≥ plen that still fits ``max_len`` with ``max_new`` to go.
+        ``None``/off → exact."""
+        from tpudl.compile import resolve_ladder
+
+        ladder = resolve_ladder(prompt_buckets)
+        if ladder is None:
+            return plen
+        return max(plen, min(ladder.pick(plen),
+                             self.max_len - max_new))
+
+    def generate(self, params, prompt, max_new: int, *,
+                 temperature: float = 0.0, rng=None,
+                 prompt_buckets=None):
+        """Autoregressive continuation: ``prompt`` [B, P] int32 →
+        [B, max_new] int32. One jitted program: prefill scans
+        :meth:`decode_step` over the prompt (filling the cache),
+        generation scans it over ``max_new`` steps feeding each
+        prediction back in. ``temperature=0`` is greedy argmax;
+        otherwise softmax sampling with ``rng`` (a jax PRNG key).
+        Total length must fit ``max_len``.
+
+        ``prompt_buckets`` (a :class:`tpudl.compile.BucketLadder`, a
+        spec string, or ``True`` for the default ladder; ``None`` =
+        off) right-pads the prompt to the nearest ladder rung so
+        serving with ragged prompt lengths compiles O(log max_len)
+        programs instead of one per novel length — the real length
+        stays a traced argument (masked prefill), so results match the
+        exact-length program for the real tokens."""
+        prompt = jnp.asarray(prompt, jnp.int32)
+        b, plen = prompt.shape
+        total = plen + max_new
+        if total > self.max_len:
+            raise ValueError(f"prompt {plen} + max_new {max_new} exceeds "
+                             f"max_len {self.max_len}")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if plen < 1:
+            # an empty prompt makes the prefill scan a no-op: the first
+            # token would be picked from the zero-initialized logits
+            # carry (always argmax of zeros), never from the model
+            raise ValueError(f"prompt must hold >= 1 token, got shape "
+                             f"{tuple(prompt.shape)}")
+        if temperature > 0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs rng=")
+        padded = self._gen_bucket(plen, max_new, prompt_buckets)
+        if padded > plen:
+            prompt = jnp.concatenate(
+                [prompt, jnp.zeros((b, padded - plen), jnp.int32)],
+                axis=1)
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        fn = self._gen_program(b, padded, max_new, float(temperature))
+        args = (params, prompt, key, jnp.int32(plen))
+        from tpudl.compile import aot_enabled, get_program_store
+
+        if aot_enabled():
+            # serving hot path: a store hit (precompile_generate, or a
+            # restored executable from the last process) dispatches the
+            # prefill/decode scans with zero trace; a miss records the
+            # geometry so the next process restores it
+            return get_program_store().call(fn, args)
+        return fn(*args)
+
+    def precompile_generate(self, params, batch: int, prompt_len: int,
+                            max_new: int, *, temperature: float = 0.0,
+                            prompt_buckets=None,
+                            block: bool = True) -> bool:
+        """AOT-compile the generate program for one declared serving
+        geometry THROUGH the program store (COMPILE.md): no prompt, no
+        trace at serving time — and the serialized executable makes the
+        next process's first request hit a restored program. With
+        ``prompt_buckets`` the declared length snaps to its rung, so
+        one precompile covers every prompt in the bucket. Returns False
+        when the store is unarmed."""
+        from tpudl import compile as _compile
+
+        if not _compile.aot_enabled():
+            return False
+        padded = self._gen_bucket(int(prompt_len), int(max_new),
+                                  prompt_buckets)
+        fn = self._gen_program(int(batch), padded, int(max_new),
+                               float(temperature))
+        key = jax.random.PRNGKey(0)
+        avals = (
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(
+                jnp.shape(a), jnp.asarray(a).dtype), params),
+            jax.ShapeDtypeStruct((int(batch), padded), jnp.int32),
+            jax.ShapeDtypeStruct(jnp.shape(key),
+                                 jnp.asarray(key).dtype),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+        store = _compile.get_program_store()
+        store.ensure_restored(block=True)
+        return store.compile_signature(fn, avals, block=block)
 
     # -- training loss -----------------------------------------------------
     def loss_fn(self, *, mesh=None, use_pallas: bool = False,
