@@ -49,6 +49,13 @@ echo "== virtual-mesh executor subset (ISSUE 11 acceptance) =="
 # mask a mesh regression inside the full-suite noise.
 python -m pytest tests/test_mesh_executor.py -q "$@"
 
+echo "== 2-D mesh tensor parallelism subset (ISSUE 16 acceptance) =="
+# Target the mesh2d module DIRECTLY (same rationale as the armed
+# concurrency subset above): the TP parity matrix, the HLO collective
+# pin and the 2-D warm-restore subprocess must fail loudly on their
+# own line, not inside the full-suite noise.
+python -m pytest tests/test_mesh2d.py -q "$@"
+
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 

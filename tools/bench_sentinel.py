@@ -188,6 +188,14 @@ METRICS = [
            keys=[("mesh_scaling", "mesh_parallel_efficiency")],
            tail_patterns=[r'"mesh_parallel_efficiency": ' + _NUM],
            wire_sensitive=False, floor=0.30),
+    # 2-D twin (ISSUE 16): 4x2 tensor-parallel over 8x1 data-parallel,
+    # one Megatron-shaped program, interleaved in one child — a drop is
+    # the model axis re-growing overhead (gathered params, lost
+    # residency, extra collectives), never weather
+    Metric("mesh2d_parallel_efficiency",
+           keys=[("mesh_2d", "mesh2d_parallel_efficiency")],
+           tail_patterns=[r'"mesh2d_parallel_efficiency": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
     # host-side stages: no wire in the loop
     Metric("decode_native_images_per_sec",
            keys=[("decode", "native_images_per_sec")],

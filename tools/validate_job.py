@@ -20,11 +20,17 @@ a re-launched :class:`tpudl.jobs.JobRuntime` would bet its resume on:
   within the trial bounds;
 - **checkpoint payloads** — size + crc32 per the checkpoint manifest
   (delegated shape of train/checkpoint.py's contract, without
-  importing tpudl: validators stay pure stdlib + numpy).
+  importing tpudl: validators stay pure stdlib + numpy);
+- **resume topology** (opt-in, ``--resume-mesh data=4,model=2``) — the
+  manifest's recorded mesh must MATCH the grid the resume will run on:
+  a job trained model-sharded on a 2-D mesh resumed on a 1-D mesh
+  would load parameter shards onto the wrong topology (the static twin
+  of the JobRuntime refusal, ISSUE 11/16 — auditable before any chip
+  is reserved).
 
 Exit 0 = every manifest audited is internally consistent. Importable
-(``from validate_job import validate_workdir``) and runnable
-(``python tools/validate_job.py <workdir>``).
+(``from validate_job import validate_workdir, check_resume_topology``)
+and runnable (``python tools/validate_job.py <workdir>``).
 """
 
 from __future__ import annotations
@@ -219,6 +225,46 @@ def validate_manifest(workdir: str) -> list[str]:
     return errs
 
 
+def parse_mesh_arg(s: str) -> dict[str, int]:
+    """``"data=4,model=2"`` → ``{"data": 4, "model": 2}`` (``""`` =
+    single-chip, the {} record)."""
+    axes: dict[str, int] = {}
+    for part in filter(None, (p.strip() for p in str(s).split(","))):
+        name, _, size = part.partition("=")
+        if not name or not size.isdigit() or int(size) < 1:
+            raise ValueError(f"bad mesh axis {part!r} (want name=size)")
+        axes[name] = int(size)
+    return axes
+
+
+def check_resume_topology(workdir: str, mesh_axes) -> list[str]:
+    """Errors if resuming ``workdir`` on ``mesh_axes`` (an
+    ``{axis: size}`` dict, or a ``"data=4,model=2"`` string) would put
+    the job on a different grid than it recorded — e.g. a 2-D
+    model-sharded run resumed on a 1-D mesh. Matches the JobRuntime
+    refusal but runs offline: no jax, no devices."""
+    if isinstance(mesh_axes, str):
+        mesh_axes = parse_mesh_arg(mesh_axes)
+    path = os.path.join(workdir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable manifest ({e})"]
+    prev = m.get("mesh") if isinstance(m, dict) else None
+    if prev is None:
+        return []  # pre-topology manifest: nothing recorded to defend
+    want = {str(k): int(v) for k, v in dict(mesh_axes).items() if v != 1}
+    have = ({str(k): int(v) for k, v in prev.items() if v != 1}
+            if isinstance(prev, dict) else prev)
+    if have != want:
+        return [f"{path}: job ran on mesh {prev!r} but resume targets "
+                f"{dict(mesh_axes)!r} — a model-sharded checkpoint "
+                f"cannot load onto a different grid; rebuild the mesh "
+                f"to match or restart the job"]
+    return []
+
+
 def validate_workdir(root: str) -> tuple[list[str], int]:
     """(errors, n_manifests) over ``root`` — itself a workdir, or a
     directory of workdirs."""
@@ -243,13 +289,33 @@ def validate_workdir(root: str) -> tuple[list[str], int]:
 
 
 def main(argv) -> int:
-    if len(argv) != 2:
-        print("usage: validate_job.py <job_workdir>", file=sys.stderr)
+    args = list(argv[1:])
+    resume_mesh = None
+    if "--resume-mesh" in args:
+        i = args.index("--resume-mesh")
+        try:
+            resume_mesh = parse_mesh_arg(args[i + 1])
+        except (IndexError, ValueError) as e:
+            print(f"validate_job.py: --resume-mesh: {e}", file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    if len(args) != 1:
+        print("usage: validate_job.py <job_workdir> "
+              "[--resume-mesh data=4,model=2]", file=sys.stderr)
         return 2
-    errors, n = validate_workdir(argv[1])
+    errors, n = validate_workdir(args[0])
+    if resume_mesh is not None:
+        wd = args[0]
+        if not os.path.isfile(os.path.join(wd, MANIFEST_NAME)):
+            for name in sorted(os.listdir(wd)):
+                sub = os.path.join(wd, name)
+                if os.path.isfile(os.path.join(sub, MANIFEST_NAME)):
+                    errors.extend(check_resume_topology(sub, resume_mesh))
+        else:
+            errors.extend(check_resume_topology(wd, resume_mesh))
     for e in errors:
         print(f"INVALID: {e}", file=sys.stderr)
-    print(f"{argv[1]}: {n} job manifest(s), "
+    print(f"{args[0]}: {n} job manifest(s), "
           f"{'OK' if not errors else str(len(errors)) + ' errors'}")
     return 1 if errors else 0
 

@@ -13,6 +13,12 @@ given a store directory it checks
 - shapes↔bucket-ladder consistency: an entry marked ``bucketed`` must
   have a leading dim that IS a ladder rung (the manifest records the
   ladder it was observed under);
+- mesh-topology identity: a sharded entry must RECORD its topology
+  (``mesh_axes``: axis-name → size, parsed from the leaf sharding
+  tokens at write time) and every sharded leaf must agree with it —
+  and no two entries may describe the same program signature under
+  different keys (the 1-D/2-D identity rail: an 8×1 and a 4×2
+  executable of one fn are two entries, never one);
 - the stale-executable audit: a ``prog-*.bin`` on disk that no entry
   references is leftover garbage from a dead manifest generation
   (kill-mid-precompile leaves none — writes are atomic — so a stale
@@ -36,7 +42,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # imports no jax at module level, so the CLI stays light)
 from tpudl.compile.store import (EXE_PREFIX, MANIFEST_NAME,  # noqa: E402
                                  MANIFEST_SCHEMA, MANIFEST_VERSION,
-                                 _crc32_file, _entry_crc)
+                                 _crc32_file, _entry_crc,
+                                 _mesh_axes_of_token)
 
 _ENTRY_KEYS = {"fn": str, "tree": str, "leaves": list, "donate": bool,
                "portable": bool, "bucketed": bool, "created_ts": float,
@@ -81,6 +88,7 @@ def validate_store_dir(root: str) -> tuple[list[str], int, int]:
         return errs + [f"{path}: entries missing or not an object"], 0, 0
     ladder = _ladder(m.get("ladder"))
     referenced: set[str] = set()
+    sig_seen: dict[str, str] = {}
     n_exe = 0
     for key in sorted(entries):
         entry = entries[key]
@@ -109,6 +117,43 @@ def validate_store_dir(root: str) -> tuple[list[str], int, int]:
             errs.append(f"{where}: leaves must be [shape, dtype, "
                         f"sharding] triples")
             continue
+        # two keys for one full signature = the key derivation failed
+        # to separate them (a merged/hand-built manifest): restores
+        # would pick one of the two executables arbitrarily
+        sig_id = json.dumps([entry["fn"], entry["tree"], leaves,
+                             entry["donate"], entry.get("backend")],
+                            sort_keys=True)
+        if sig_id in sig_seen:
+            errs.append(f"{where}: same program signature as entry "
+                        f"{sig_seen[sig_id][:12]} under a different key")
+        else:
+            sig_seen[sig_id] = key
+        # mesh-topology identity: sharded entries must record the
+        # topology they were compiled for, and record it consistently
+        leaf_topos = {}
+        for i, lf in enumerate(leaves):
+            axes = _mesh_axes_of_token(lf[2])
+            if axes is not None:
+                leaf_topos[i] = axes
+        mesh_axes = entry.get("mesh_axes")
+        if leaf_topos:
+            topos = {json.dumps(a, sort_keys=True)
+                     for a in leaf_topos.values()}
+            if len(topos) > 1:
+                errs.append(f"{where}: leaves disagree on mesh topology "
+                            f"({' vs '.join(sorted(topos))})")
+            elif not isinstance(mesh_axes, dict) \
+                    or not all(isinstance(k, str) and isinstance(v, int)
+                               and v > 0 for k, v in mesh_axes.items()):
+                errs.append(f"{where}: sharded entry records no "
+                            f"mesh_axes topology (pre-2-D manifest?)")
+            elif mesh_axes != next(iter(leaf_topos.values())):
+                i = next(iter(leaf_topos))
+                errs.append(f"{where}: mesh_axes {mesh_axes} != leaf "
+                            f"{i} sharding topology {leaf_topos[i]}")
+        elif mesh_axes is not None:
+            errs.append(f"{where}: mesh_axes {mesh_axes} recorded but "
+                        f"no leaf is mesh-sharded")
         if entry["bucketed"] and ladder is not None and leaves \
                 and leaves[0][0]:
             lead = int(leaves[0][0][0])

@@ -68,6 +68,10 @@ KNOBS: tuple[Knob, ...] = (
          "path (serial blocking dispatch, blocking transfer barrier, "
          "no fusion/donation/autotune under a mesh) — the A/B arm and "
          "escape hatch"),
+    Knob("TPUDL_MESH_MODEL", "int", "1", "frame",
+         "model-axis size for 2-D (data, model) meshes — build_mesh's "
+         "n_model default and the HorovodRunner/estimator grid fold "
+         "(>1 arms GSPMD tensor parallelism)"),
     Knob("TPUDL_FRAME_IO_WORKERS", "int", "8", "frame",
          "LazyFileColumn file-read threads"),
     Knob("TPUDL_FRAME_DECODE_WORKERS", "int", "1", "frame",
@@ -246,6 +250,8 @@ KNOBS: tuple[Knob, ...] = (
          "async-dispatch A/B sub-bench depth-D arm window size"),
     Knob("TPUDL_BENCH_MESH_N", "int", "1024", "bench",
          "mesh-scaling sub-bench row count (virtual 8-device child)"),
+    Knob("TPUDL_BENCH_MESH2D_N", "int", "1024", "bench",
+         "2-D mesh sub-bench row count (8x1 vs 4x2 interleaved child)"),
     Knob("TPUDL_BENCH_FLASH_SEQS", "str", "2048,4096,8192,16384",
          "bench", "flash-attention sub-bench sequence-length ladder"),
     Knob("TPUDL_BENCH_PREEMPT_STEPS", "int", "300", "bench",

@@ -74,6 +74,12 @@ METRICS: tuple[Metric, ...] = (
     Metric("frame.mesh.pad_overhead_pct", "gauge",
            "pad rows as a percent of the last mesh run's dispatched "
            "rows"),
+    Metric("frame.mesh.model_axis", "gauge",
+           "model-axis size of the last mesh run's grid (1 = pure "
+           "data parallelism, >1 = GSPMD tensor parallelism)"),
+    Metric("frame.mesh.idle_devices", "gauge",
+           "devices stranded by a grid smaller than the host's device "
+           "count (build_mesh warn-once rides along)"),
     Metric("queue_depth", "report-gauge",
            "infeed queue depth sampled per batch (PipelineReport)"),
     Metric("dispatch_inflight", "report-gauge",
@@ -249,7 +255,10 @@ METRICS: tuple[Metric, ...] = (
            "top advisor recommendation's predicted gain"),
     Metric("obs.roofline.gap_frac.*", "gauge",
            "device-vs-e2e gap share attributed per component "
-           "(prepare/wire_h2d/dispatch/d2h/other)"),
+           "(prepare/wire_h2d/dispatch/d2h/other/collective)"),
+    Metric("obs.roofline.collective_s", "gauge",
+           "gap seconds attributed to model-axis collectives (2-D "
+           "mesh runs with a measured comm share)"),
 )
 
 METRIC_NAMES = frozenset(m.name for m in METRICS if "*" not in m.name)

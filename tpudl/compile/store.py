@@ -168,6 +168,14 @@ def _hash_obj(h, obj, depth: int, seen: set, state: dict) -> None:
     if isinstance(obj, np.ndarray):
         _hash_array(h, obj)
         return
+    if jax is not None and isinstance(obj, jax.sharding.Mesh):
+        # a Mesh in a closure (the tp generate path closes over it) is
+        # topology, not content: hash axis names + grid shape. The
+        # generic walk below would reach the `devices` object ndarray
+        # and hash per-process POINTERS — a fingerprint that never
+        # matches across runs
+        h.update(f"&mesh{dict(obj.shape)!r}".encode())
+        return
     code = getattr(obj, "__code__", None)
     if code is not None:
         h.update(f"&fn{getattr(obj, '__qualname__', '?')}".encode())
@@ -287,6 +295,22 @@ def _sharding_token(x) -> str:
     # host-lowered executable accepts either (the runtime places host
     # args), so a warmup-declared aval must key like the live array
     return "host"
+
+
+def _mesh_axes_of_token(tok) -> dict | None:
+    """Structured ``{axis: size}`` topology parsed back out of a leaf
+    sharding token (``"P(...)|[('data', 4), ('model', 2)]"``) — what
+    manifest audits (tools/validate_programs.py) compare, so 1-D and
+    2-D entries can be told apart without re-parsing token strings."""
+    if not tok or "|" not in tok:
+        return None
+    import ast
+
+    try:
+        pairs = ast.literal_eval(tok.split("|", 1)[1])
+        return {str(k): int(v) for k, v in pairs}
+    except (ValueError, SyntaxError, TypeError):
+        return None
 
 
 def signature_of(args) -> dict:
@@ -570,15 +594,17 @@ class ProgramStore:
 
     def _new_entry(self, sig: dict, *, fn_fp: str, donate: bool,
                    portable: bool, bucketed: bool) -> dict:
-        mesh_axes = None
+        mesh_tok = None
         for leaf in sig["leaves"]:
             if leaf[2] not in ("host", "device"):
-                mesh_axes = leaf[2]
+                mesh_tok = leaf[2]
                 break
         return {"fn": fn_fp, "tree": sig["tree"],
                 "leaves": sig["leaves"], "donate": bool(donate),
                 "portable": bool(portable), "bucketed": bool(bucketed),
-                "mesh": mesh_axes, "backend": self._backend_or_none(),
+                "mesh": mesh_tok,
+                "mesh_axes": _mesh_axes_of_token(mesh_tok),
+                "backend": self._backend_or_none(),
                 "created_ts": time.time(), "compile_s": None,
                 "exe": None, "exe_crc32": None, "exe_nbytes": None}
 

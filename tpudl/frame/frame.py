@@ -369,7 +369,13 @@ def mesh_fuse_ok(batch_size: int, mesh) -> bool:
     per-microbatch padding would leave pad rows INTERLEAVED in the
     flattened output. Pick ``batch_size % data-axis == 0`` to enable
     mesh fusion; the ragged TAIL batch always pads + dispatches
-    per-batch either way. ``mesh=None`` imposes no constraint."""
+    per-batch either way. ``mesh=None`` imposes no constraint.
+
+    On a 2-D ``(data, model)`` grid only the DATA-axis size gates:
+    batches shard over ``data`` while the model axis holds parameter
+    shards (which never ride the transfer edge — transfer_batch passes
+    model-resident leaves through untouched), so a 4×2 mesh fuses at
+    any ``batch_size % 4 == 0``, not ``% 8``."""
     if mesh is None:
         return True
     if os.environ.get("TPUDL_MESH_FAST_PATH", "1") == "0":
@@ -585,6 +591,14 @@ class Frame:
         conservative pre-ISSUE-11 escape hatch). This is the rebuild of
         the reference's per-partition TensorFrames MapBlocks execution,
         minus the JVM.
+
+        A 2-D ``(data, model)`` mesh works identically (ISSUE 16):
+        batches still ride the one transfer edge sharded over ``data``,
+        while ``fn``'s model-sharded closures/params stay device-
+        resident under their ``P(None, 'model')``-family shardings
+        (transfer_batch passes them through without gathering) — every
+        gate keys on the DATA-axis size, so the full fast path stays
+        armed at ``n_model > 1``.
 
         ``batch_size`` defaults to the frame's ``num_partitions`` hint
         (``ceil(rows / num_partitions)`` — the Spark-side meaning of a

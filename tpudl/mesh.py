@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import contextlib
 import math
+import os
+import warnings
 from collections.abc import Sequence
 
 import jax
@@ -32,6 +34,7 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "build_mesh",
+    "model_axis_size",
     "replicated",
     "batch_sharding",
     "stacked_batch_sharding",
@@ -40,6 +43,8 @@ __all__ = [
     "replicate",
     "pad_batch",
     "unpad_batch",
+    "require_hbm_fit",
+    "bytes_per_device",
     "local_device_count",
     "use_mesh",
 ]
@@ -52,20 +57,64 @@ def local_device_count() -> int:
     return jax.local_device_count()
 
 
+def model_axis_size() -> int:
+    """The process-default tensor-parallel degree: ``TPUDL_MESH_MODEL``
+    (ANALYSIS.md), floor 1. Consumed wherever a mesh is built WITHOUT an
+    explicit ``n_model`` (HorovodRunner, the estimator's sub-mesh
+    trials), so one env knob turns a whole job tensor-parallel without
+    touching call sites."""
+    try:
+        return max(1, int(os.environ.get("TPUDL_MESH_MODEL", "1")))
+    except ValueError:
+        return 1
+
+
+_warned_idle_devices = False
+
+
+def _warn_idle_devices_once(n_data: int, n_model: int, idle: int,
+                            total: int) -> None:
+    global _warned_idle_devices
+    # the gauge updates every build (a later, correctly-sized mesh
+    # clears it); the warning fires once per process
+    try:
+        from tpudl.obs import metrics as _metrics
+
+        _metrics.gauge("frame.mesh.idle_devices").set(idle)
+    # tpudl: ignore[swallowed-except] — obs may be unimportable in a
+    # minimal subprocess; the warning below still fires
+    except Exception:
+        pass
+    if idle == 0 or _warned_idle_devices:
+        return
+    _warned_idle_devices = True
+    warnings.warn(
+        f"build_mesh({n_data}x{n_model}) uses {n_data * n_model} of "
+        f"{total} visible devices — {idle} device(s) sit IDLE. Size the "
+        f"grid to cover the slice (n_data * n_model == device count) or "
+        f"pass devices= explicitly; frame.mesh.idle_devices gauges the "
+        f"stranded count.", RuntimeWarning, stacklevel=3)
+
+
 def build_mesh(
     n_data: int | None = None,
-    n_model: int = 1,
+    n_model: int | None = None,
     *,
     devices: Sequence[jax.Device] | None = None,
     axis_names: tuple[str, ...] = (DATA_AXIS, MODEL_AXIS),
 ) -> Mesh:
     """Build a 2-D logical mesh ``(data, model)`` over the available devices.
 
-    ``n_data`` defaults to ``len(devices) // n_model``. A ``model`` axis of
+    ``n_model`` defaults to ``TPUDL_MESH_MODEL`` (1 when unset) and
+    ``n_data`` to ``len(devices) // n_model``. A ``model`` axis of
     size 1 costs nothing and keeps tensor-parallel shardings expressible
-    without re-tracing user code when the axis later grows.
+    without re-tracing user code when the axis later grows. A grid that
+    covers fewer devices than are visible strands the rest — loud
+    warn-once + the ``frame.mesh.idle_devices`` gauge.
     """
     devs = list(devices) if devices is not None else jax.devices()
+    if n_model is None:
+        n_model = model_axis_size()
     if n_data is None:
         n_data = len(devs) // n_model
     want = n_data * n_model
@@ -73,6 +122,7 @@ def build_mesh(
         raise ValueError(
             f"mesh {n_data}x{n_model} needs {want} devices, have {len(devs)}"
         )
+    _warn_idle_devices_once(n_data, n_model, len(devs) - want, len(devs))
     grid = np.asarray(devs[:want]).reshape(n_data, n_model)
     return Mesh(grid, axis_names)
 
@@ -99,7 +149,11 @@ def stacked_batch_sharding(mesh: Mesh, axis: str = DATA_AXIS,
 
 def replicate(tree, mesh: Mesh):
     """Place every leaf on-device fully replicated (Spark broadcast
-    analogue) — ONE batched ``device_put`` for the whole tree."""
+    analogue) — ONE batched ``device_put`` for the whole tree. Under an
+    explicit ``TPUDL_DATA_HBM_BUDGET_MB`` the placement is budget-
+    checked first (:func:`require_hbm_fit`): replicating a model that
+    only fits sharded must die typed, not as an allocator fault."""
+    require_hbm_fit(tree, None, what="replicated tree")
     sh = replicated(mesh)
     return jax.device_put(tree, jax.tree.map(lambda _: sh, tree))
 
@@ -146,7 +200,13 @@ def transfer_batch(tree, mesh: Mesh, axis: str = DATA_AXIS, *,
     device-cache hit — DATA.md "Cache hierarchy") passes through
     untouched: zero wire bytes, and crucially no ``np.asarray`` — the
     old unconditional host staging would have GATHERED the resident
-    shard back to host just to re-ship it."""
+    shard back to host just to re-ship it. The same pass-through covers
+    MODEL-sharded resident leaves (tensor-parallel params/closures on a
+    2-D grid, under their ``P(None, "model")``-family shardings):
+    batch-resharding a param shard would all-gather 1/tp of the model
+    per device just to re-split it, so any leaf whose sharding lives on
+    this mesh and references the ``model`` axis stays exactly where it
+    is — activations ride the wire, weights never move."""
     # THE transfer fault point (tpudl.testing.faults): the chaos suite
     # injects transfer failures at the one edge every mesh H2D crosses;
     # unarmed this is a global None-check
@@ -159,7 +219,8 @@ def transfer_batch(tree, mesh: Mesh, axis: str = DATA_AXIS, *,
     out: list = [None] * len(leaves)
     to_put, to_put_sh, to_put_idx = [], [], []
     for i, (x, sh) in enumerate(zip(leaves, shardings)):
-        if isinstance(x, jax.Array) and x.sharding == sh:
+        if isinstance(x, jax.Array) and (
+                x.sharding == sh or _model_resident(x, mesh)):
             out[i] = x  # resident replay: no transfer, no host bounce
         else:
             to_put.append(np.asarray(x))
@@ -172,10 +233,79 @@ def transfer_batch(tree, mesh: Mesh, axis: str = DATA_AXIS, *,
     return jax.tree.unflatten(treedef, out)
 
 
+def _model_resident(x: jax.Array, mesh: Mesh) -> bool:
+    """True when ``x`` is already device-resident on ``mesh`` under a
+    sharding that references the ``model`` axis — the tensor-parallel
+    pass-through predicate of :func:`transfer_batch`. Exact-spec
+    residency is checked by the caller; this only widens it to
+    model-sharded leaves (a stale DATA-axis sharding still re-ships, so
+    a wrong ``batch_dim`` can't silently reuse it)."""
+    sh = getattr(x, "sharding", None)
+    if not isinstance(sh, NamedSharding) or sh.mesh != mesh:
+        return False
+
+    def axes(spec):
+        for s in spec:
+            if isinstance(s, (tuple, list)):
+                yield from s
+            elif s is not None:
+                yield s
+
+    return MODEL_AXIS in set(axes(tuple(sh.spec)))
+
+
 def shard_batch(tree, mesh: Mesh, axis: str = DATA_AXIS):
     """``transfer_batch`` with the leading dim sharded — kept as the
     short spelling every training/estimator call site uses."""
     return transfer_batch(tree, mesh, axis)
+
+
+def bytes_per_device(tree, shardings=None) -> int:
+    """Per-device resident bytes of placing ``tree`` under
+    ``shardings`` (a matching NamedSharding pytree; ``None`` = fully
+    replicated). Uses each sharding's own ``shard_shape`` so nested
+    axis specs (``P(("data", "model"))`` etc.) divide correctly."""
+    total = 0
+    leaves = jax.tree.leaves(tree)
+    shards = (jax.tree.leaves(shardings) if shardings is not None
+              else [None] * len(leaves))
+    for leaf, sh in zip(leaves, shards):
+        a = np.asarray(leaf) if not hasattr(leaf, "shape") else leaf
+        shape = tuple(a.shape)
+        if sh is not None and hasattr(sh, "shard_shape"):
+            shape = sh.shard_shape(shape)
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(
+            a.dtype).itemsize
+    return total
+
+
+def require_hbm_fit(tree, shardings=None, *, what: str = "params") -> None:
+    """Refuse a placement whose PER-DEVICE bytes exceed the declared
+    ``TPUDL_DATA_HBM_BUDGET_MB`` budget — typed (``DeviceOOM``), before
+    any wire bytes move. Only armed when the budget is EXPLICIT (the
+    derived device-cache default stays a cache policy, not a placement
+    veto). This is the "models bigger than one chip" gate: a replicated
+    (or 1-wide ``model`` axis) placement of params that only fit
+    sharded fails HERE with the budget arithmetic in the message,
+    instead of as an opaque allocator death mid-transfer."""
+    if not os.environ.get("TPUDL_DATA_HBM_BUDGET_MB"):
+        return
+    from tpudl.data.device_cache import budget_bytes
+
+    budget = budget_bytes(allow_device=False)
+    if not budget:
+        # explicit 0 means "data-cache residency forbidden" (DATA.md),
+        # not a zero-HBM chip — placements stay ungated
+        return
+    need = bytes_per_device(tree, shardings)
+    if need > budget:
+        from tpudl.frame.supervisor import DeviceOOM
+
+        raise DeviceOOM(
+            f"{what} need {need / 2**20:.1f} MB per device but "
+            f"TPUDL_DATA_HBM_BUDGET_MB grants {budget / 2**20:.1f} MB — "
+            f"shard over a wider 'model' axis (build_mesh(n_model=...), "
+            f"param_shardings) or raise the budget")
 
 
 @contextlib.contextmanager

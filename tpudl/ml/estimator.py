@@ -79,7 +79,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                  kerasLoss=None, kerasFitParams=None, mesh=None,
                  prefetchDepth=None, prepareWorkers=None, fuseSteps=None,
                  dispatchDepth=None, wireCodec=None, cacheDir=None,
-                 deviceCache=None, trialRetryPolicy=None):
+                 deviceCache=None, trialRetryPolicy=None,
+                 modelAxis=None, paramShardings=None):
         super().__init__()
         self._setDefault(kerasFitParams={"batch_size": 32, "epochs": 1,
                                          "verbose": 0})
@@ -110,6 +111,15 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         # fitMultiple sweep (TrialScheduler.run's retry= contract; None
         # falls back to the TPUDL_HPO_TRIAL_ATTEMPTS env opt-in)
         self.trialRetryPolicy = trialRetryPolicy
+        # 2-D tensor parallelism for a trial's device slice (ISSUE 16):
+        # modelAxis folds the slice into a (data, model) grid (None =
+        # the TPUDL_MESH_MODEL env knob) and paramShardings — a
+        # callable mesh -> NamedSharding pytree, e.g. a zoo model's
+        # .param_shardings — places the trial's params model-SHARDED
+        # instead of replicated, so graphs bigger than one chip's HBM
+        # share fit on a slice
+        self.modelAxis = modelAxis
+        self.paramShardings = paramShardings
         self._save_lock = _tsan.named_lock("ml.estimator.save")
         # one compiled train step per (ingested graph, loss, optimizer),
         # shared across every trial (learning rate is dynamic in opt_state,
@@ -122,7 +132,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         kwargs.pop("mesh", None)
         for k in ("prefetchDepth", "prepareWorkers", "fuseSteps",
                   "dispatchDepth", "wireCodec", "cacheDir",
-                  "deviceCache", "trialRetryPolicy"):
+                  "deviceCache", "trialRetryPolicy", "modelAxis",
+                  "paramShardings"):
             kwargs.pop(k, None)
         self._set(**kwargs)
 
@@ -243,8 +254,22 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                                codec=codec)
 
         devs = list(devices) if devices is not None else None
-        submesh = (M.build_mesh(devices=devs)
-                   if devs is not None and len(devs) > 1 else None)
+        # modelAxis folds the slice into a 2-D (data, model) grid —
+        # params then place via the paramShardings plan below instead
+        # of replicating (None defers to the TPUDL_MESH_MODEL knob)
+        n_model = (int(self.modelAxis) if self.modelAxis is not None
+                   else M.model_axis_size())
+        submesh = None
+        if devs is not None and len(devs) > 1:
+            if n_model > 1:
+                if len(devs) % n_model:
+                    raise ValueError(
+                        f"trial slice of {len(devs)} devices does not "
+                        f"divide into modelAxis={n_model} model shards")
+                submesh = M.build_mesh(n_data=len(devs) // n_model,
+                                       n_model=n_model, devices=devs)
+            else:
+                submesh = M.build_mesh(devices=devs)
         # HBM-tier bulk residency (the multi-epoch bulk path of ISSUE
         # 12): place X/y on the trial's device ONCE under the shared
         # device-cache budget — epochs ≥ 2 then index batches on
@@ -280,7 +305,19 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         # another
         try:
             if submesh is not None:
-                params = M.replicate(gin.params, submesh)
+                plan = (self.paramShardings(submesh)
+                        if callable(self.paramShardings)
+                        else self.paramShardings)
+                if plan is not None:
+                    # model-sharded trial: each device holds 1/tp of
+                    # every planned leaf (typed DeviceOOM refusal first
+                    # when even the shards exceed the HBM budget)
+                    M.require_hbm_fit(gin.params, plan,
+                                      what="trial params")
+                    params = jax.tree.map(jax.device_put, gin.params,
+                                          plan)
+                else:
+                    params = M.replicate(gin.params, submesh)
             elif devs is not None:
                 params = jax.device_put(gin.params, devs[0])
             else:
@@ -303,7 +340,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             # duplication, which would double-weight the padding rows
             # in the mean loss and make identical hyperparams train
             # differently on different-width slices.
-            width = len(devs) if submesh is not None else 1
+            # batches shard over the DATA axis only — on a 2-D slice
+            # the model axis holds param shards, not batch rows
+            width = (submesh.shape[M.DATA_AXIS] if submesh is not None
+                     else 1)
             target = math.ceil(batch_size / width) * width
             losses = []
             n_steps = 0

@@ -98,7 +98,7 @@ def _run_entry(report: dict) -> dict:
         "config": {k: report.get(k) for k in (
             "executor", "batch_size", "fuse_steps", "prefetch_depth",
             "prepare_workers", "wire_codec", "batch_cache",
-            "device_cache", "degraded_to", "recovered_batches")
+            "device_cache", "degraded_to", "recovered_batches", "mesh")
             if report.get(k) is not None},
     }
     if rows_total:
@@ -446,6 +446,13 @@ def render(statuses: list[dict], now: float | None = None) -> str:
                 + f" |{_bar(pct)}|"
                 + (f" {rate:.1f} rows/s" if rate else "")
                 + (f" ETA {_fmt_age(eta)}" if eta is not None else "")
+                # mesh topology on the run line (ISSUE 16): a glance
+                # distinguishes an 8x1 data-parallel run from a 4x2
+                # tensor-parallel one without digging into the knobs
+                + (" mesh={}".format("x".join(
+                    str((run.get("config") or {})["mesh"].get(a, 1))
+                    for a in ("data", "model")))
+                   if (run.get("config") or {}).get("mesh") else "")
                 # fault containment: a run surviving on a degraded rung
                 # is loud here — same field the PipelineReport carries
                 + (f" DEGRADED->{(run.get('config') or {})['degraded_to']}"

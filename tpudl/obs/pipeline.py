@@ -232,6 +232,13 @@ class PipelineReport:
             if rows:
                 _metrics.gauge("frame.mesh.pad_overhead_pct").set(
                     100.0 * pad / (int(rows) + pad))
+            # 2-D grid truth (ISSUE 16): the model-axis size the run
+            # actually executed under — 1 on a data-parallel mesh, >1
+            # when tensor-parallel params were resident. obs top and
+            # the mesh_2d bench read this to prove the second axis was
+            # armed, not silently collapsed to 1-D.
+            _metrics.gauge("frame.mesh.model_axis").set(
+                int(self.config["mesh"].get("model") or 1))
         _metrics.get_registry().maybe_flush()
 
     def report(self) -> dict:

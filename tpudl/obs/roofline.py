@@ -70,6 +70,10 @@ class RooflineReport:
     - ``prepare_unhidden_s`` consumer seconds blocked on the infeed
       (``infeed_wait`` — prepare work prefetch failed to hide);
     - ``d2h_s``              measured outfeed drain;
+    - ``collective_s``       model-axis communication on a 2-D mesh
+      (the tensor-parallel all-reduce/reduce-scatter share of the
+      dispatch window — supplied per dispatch by a profile or the
+      mesh_2d bench's arm delta; 0 on 1-D grids);
     - ``other_s``            wall minus all of the above (host glue).
 
     ``gap_attribution`` maps each non-compute component to its fraction
@@ -89,6 +93,7 @@ class RooflineReport:
         self.dispatch_overhead_s = kw.get("dispatch_overhead_s")
         self.prepare_unhidden_s = kw.get("prepare_unhidden_s")
         self.d2h_s = kw.get("d2h_s")
+        self.collective_s = kw.get("collective_s")
         self.other_s = kw.get("other_s")
         self.gap_s = kw.get("gap_s")
         self.gap_attribution = kw.get("gap_attribution") or {}
@@ -120,6 +125,7 @@ class RooflineReport:
             "dispatch_overhead_s": r(self.dispatch_overhead_s),
             "prepare_unhidden_s": r(self.prepare_unhidden_s),
             "d2h_s": r(self.d2h_s),
+            "collective_s": r(self.collective_s),
             "other_s": r(self.other_s),
             "gap_s": r(self.gap_s),
             "gap_attribution": {k: r(v) for k, v
@@ -158,6 +164,7 @@ def analyze(report: dict | None = None, *,
             h2d_mbps: float | None = None,
             device_ms_per_dispatch: float | None = None,
             bytes_prepared: float | None = None,
+            collective_ms_per_dispatch: float | None = None,
             publish: bool = True,
             allow_probe: bool = True) -> RooflineReport | None:
     """Build a :class:`RooflineReport` from one pipeline-report dict.
@@ -169,6 +176,12 @@ def analyze(report: dict | None = None, *,
     programs); when absent (``TPUDL_DEVICE_MS_PER_STEP`` is read as a
     fallback) the dispatch stage is attributed whole, un-split.
     ``bytes_prepared`` overrides the executor's own byte accounting.
+    ``collective_ms_per_dispatch`` is the model-axis communication time
+    of ONE dispatch (a profile's ICI all-reduce/reduce-scatter total,
+    or the mesh_2d bench's measured TP-vs-DP arm delta); it carves a
+    ``collective`` component out of the dispatch residue — only
+    honored when the report ran on a mesh whose ``model`` axis is >1
+    (on a 1-D grid there is no model-axis traffic to attribute).
     Returns None when the report has no dispatches to attribute.
     """
     if report is None:
@@ -250,11 +263,25 @@ def analyze(report: dict | None = None, *,
         wire_h2d = min(explicit_h2d, max(
             0.0, gap - prepare_unhidden - d2h - dispatch_comp))
 
+    # model-axis communication (ISSUE 16): tensor-parallel collectives
+    # execute INSIDE the dispatched program, so their time hides in the
+    # dispatch residue — a supplied per-dispatch collective time carves
+    # it out as its own component (clamped: a profile from different
+    # weather may not "explain" more dispatch time than was measured)
+    model_axis = int((report.get("mesh") or {}).get("model") or 1)
+    collective_s = 0.0
+    if (collective_ms_per_dispatch is not None
+            and collective_ms_per_dispatch > 0 and model_axis > 1):
+        collective_s = min(n_disp * collective_ms_per_dispatch / 1e3,
+                           max(0.0, dispatch_comp))
+        dispatch_comp = max(0.0, dispatch_comp - collective_s)
+
     comps = {
         "prepare": prepare_unhidden,
         "wire_h2d": wire_h2d or 0.0,
         "dispatch": dispatch_comp,
         "d2h": d2h,
+        "collective": collective_s,
     }
     other = max(0.0, gap - sum(comps.values()))
     attribution = {}
@@ -273,7 +300,8 @@ def analyze(report: dict | None = None, *,
         achieved_rows_per_s=achieved, achievable_rows_per_s=achievable,
         device_compute_s=device_s, wire_h2d_s=wire_h2d,
         dispatch_overhead_s=dispatch_overhead,
-        prepare_unhidden_s=prepare_unhidden, d2h_s=d2h, other_s=other,
+        prepare_unhidden_s=prepare_unhidden, d2h_s=d2h,
+        collective_s=collective_s or None, other_s=other,
         gap_s=gap, gap_attribution=attribution, bottleneck=bottleneck,
         inputs={
             "h2d_mbps": h2d_mbps,
@@ -292,6 +320,8 @@ def analyze(report: dict | None = None, *,
             # compute, not the per-dispatch round-trip, so on a
             # wire-bound tunnel overlap matters MORE per chip
             "mesh": report.get("mesh"),
+            "model_axis": model_axis,
+            "collective_ms_per_dispatch": collective_ms_per_dispatch,
             "h2d_s": explicit_h2d or None,
             "pad_rows": calls.get("pad_rows"),
             # HBM residency (ISSUE 12): whether the run already rode
@@ -483,6 +513,25 @@ def advise(rr: RooflineReport) -> list[dict]:
                  f"{budget / 2**20:.0f} MB HBM budget; device-resident "
                  f"batches make every later epoch ship zero wire "
                  f"bytes (DATA.md 'Cache hierarchy')")
+    # 6) model-axis collectives (ISSUE 16): a 2-D run whose dispatch
+    #    window is mostly TP communication is over-sharded for its
+    #    per-device compute — a narrower model axis (if the params
+    #    still fit) trades collective hops back for arithmetic.
+    #    Advisory only (never autotuned: resizing the grid re-places
+    #    every parameter shard).
+    if (rr.collective_s is not None
+            and rr.collective_s > _MINOR_FRAC * rr.gap_s):
+        cur_tp = max(1, int(inp.get("model_axis") or 1))
+        if cur_tp > 1:
+            new_tp = cur_tp // 2
+            # halving the axis roughly halves the per-layer all-reduce
+            # payload each device sends (ring cost ∝ (tp-1)/tp)
+            saved = rr.collective_s * 0.5
+            _rec("model_axis", cur_tp, new_tp, saved,
+                 f"model-axis collectives are {rr.collective_s:.2f}s "
+                 f"of the run; if the params fit {new_tp}-way "
+                 f"(TPUDL_MESH_MODEL={new_tp}), a narrower grid trades "
+                 f"ICI hops back for local compute")
     recs.sort(key=lambda r: -r["predicted_gain_pct"])
     return recs
 
@@ -508,7 +557,8 @@ def _verdict(rr: RooflineReport) -> str:
                 f"within 20% of the chip's "
                 f"{rr.achievable_rows_per_s:.0f} rows/s ceiling")
     name = {"dispatch": "dispatch-bound", "wire_h2d": "wire-bound",
-            "prepare": "prepare-bound", "d2h": "outfeed-bound"}.get(
+            "prepare": "prepare-bound", "d2h": "outfeed-bound",
+            "collective": "collective-bound"}.get(
                 rr.bottleneck, "host-bound")
     if rr.advice:
         top = rr.advice[0]
@@ -531,6 +581,10 @@ def _publish(rr: RooflineReport) -> None:
             rr.achievable_rows_per_s)
     for comp, frac in (rr.gap_attribution or {}).items():
         _m.gauge(f"obs.roofline.gap_frac.{comp}").set(frac)
+    if rr.collective_s:
+        # model-axis comm seconds (ISSUE 16) — absolute, beside the
+        # normalized gap_frac.collective fraction above
+        _m.gauge("obs.roofline.collective_s").set(rr.collective_s)
     if rr.advice:
         _m.gauge("obs.roofline.predicted_gain_pct").set(
             rr.advice[0]["predicted_gain_pct"])

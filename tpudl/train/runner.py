@@ -165,7 +165,16 @@ class HorovodRunner:
             raise ValueError(
                 f"HorovodRunner(np={self._np}) needs {n} devices, have "
                 f"{len(devs)} ({devs[0].platform})")
-        return M.build_mesh(n_data=n, devices=devs[:n])
+        # TPUDL_MESH_MODEL>1 folds the same n devices into a 2-D
+        # (data, model) grid — np keeps meaning TOTAL chips (the
+        # reference's contract), the model axis comes out of it
+        n_model = M.model_axis_size()
+        if n % n_model:
+            raise ValueError(
+                f"HorovodRunner(np={self._np}): {n} devices do not "
+                f"divide into TPUDL_MESH_MODEL={n_model} model shards")
+        return M.build_mesh(n_data=n // n_model, n_model=n_model,
+                            devices=devs[:n])
 
     def run(self, main, **kwargs):
         """Run ``main(ctx, **kwargs)`` over the mesh; on exception,
@@ -300,6 +309,11 @@ class Trainer:
         if self.mesh is not None and not all(
                 _spans_mesh(leaf) for leaf in jax.tree.leaves(params)):
             if self.param_shardings is not None:
+                # typed refusal BEFORE any transfer when the per-device
+                # share exceeds TPUDL_DATA_HBM_BUDGET_MB (the "widen the
+                # model axis" signal, same rail as zoo shard_params)
+                M.require_hbm_fit(params, self.param_shardings,
+                                  what="model-sharded params")
                 params = jax.tree.map(jax.device_put, params,
                                       self.param_shardings)
                 # an opt_state built from SHARDED params gets sharded

@@ -186,11 +186,47 @@ class TinyCausalLM:
 
     def shard_params(self, params, mesh, model_axis: str = "model"):
         """device_put ``params`` with :meth:`param_shardings` — each
-        device holds 1/tp of every column/row-parallel matrix."""
+        device holds 1/tp of every column/row-parallel matrix. Checked
+        against ``TPUDL_DATA_HBM_BUDGET_MB`` first: a layout whose
+        per-device share exceeds the budget raises a typed
+        :class:`~tpudl.frame.supervisor.DeviceOOM` BEFORE any transfer
+        — widen the ``model`` axis instead of crashing a chip."""
         import jax
 
-        return jax.tree.map(jax.device_put, params,
-                            self.param_shardings(mesh, model_axis))
+        from tpudl import mesh as M
+
+        shardings = self.param_shardings(mesh, model_axis)
+        M.require_hbm_fit(params, shardings,
+                          what=f"{self.aot_token} params")
+        return jax.tree.map(jax.device_put, params, shardings)
+
+    def _tp_hooks(self, mesh, tp):
+        """``(tp_constrain, head_axis)`` shared by :meth:`apply` and
+        :meth:`decode_step` — the ONE definition of how tensor
+        parallelism constrains activations, so training and serving can
+        never silently diverge on sharding."""
+        if tp and (mesh is None or "model" not in mesh.shape):
+            raise ValueError(
+                "tp=True needs a mesh with a 'model' axis "
+                "(tpudl.mesh.build_mesh(n_data=..., n_model=...))")
+        head_axis = "model" if tp and mesh.shape["model"] > 1 else None
+
+        def tp_constrain(t, spec):
+            # Pin ONLY the model-axis dim; every None becomes
+            # UNCONSTRAINED so GSPMD keeps whatever batch/seq sharding
+            # the surrounding program chose (a None here would mean
+            # "replicated" and force per-layer all-gathers of the
+            # DP-sharded activations over the data axis — verified in
+            # HLO during review).
+            if head_axis is None:
+                return t
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = tuple(P.UNCONSTRAINED if s is None else s for s in spec)
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(mesh, P(*spec)))
+
+        return tp_constrain, head_axis
 
     # -- forward ----------------------------------------------------------
     def apply(self, params, tokens, *, mesh=None, use_pallas: bool = False,
@@ -219,26 +255,7 @@ class TinyCausalLM:
         if s > self.max_len:
             raise ValueError(
                 f"sequence length {s} exceeds max_len {self.max_len}")
-        if tp and (mesh is None or "model" not in mesh.shape):
-            raise ValueError(
-                "tp=True needs a mesh with a 'model' axis "
-                "(tpudl.mesh.build_mesh(n_data=..., n_model=...))")
-        head_axis = "model" if tp and mesh.shape["model"] > 1 else None
-
-        def tp_constrain(t, spec):
-            # Pin ONLY the model-axis dim; every None becomes
-            # UNCONSTRAINED so GSPMD keeps whatever batch/seq sharding
-            # the surrounding program chose (a None here would mean
-            # "replicated" and force per-layer all-gathers of the
-            # DP-sharded activations over the data axis — verified in
-            # HLO during review).
-            if head_axis is None:
-                return t
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            spec = tuple(P.UNCONSTRAINED if s is None else s for s in spec)
-            return jax.lax.with_sharding_constraint(
-                t, NamedSharding(mesh, P(*spec)))
+        tp_constrain, head_axis = self._tp_hooks(mesh, tp)
 
         x = params["embed"]["table"][tokens]              # [B, S, D]
 
@@ -387,18 +404,33 @@ class TinyCausalLM:
 
     # -- autoregressive decode (KV cache) ----------------------------------
     def init_cache(self, batch: int, max_len: int | None = None,
-                   dtype=jnp.float32):
+                   dtype=jnp.float32, *, mesh=None, tp: bool = False):
         """Per-layer K/V buffers for incremental decoding:
         ``[B, max_len, heads, head_dim]`` zeros. Static shapes — the
         decode loop writes position ``pos`` via dynamic_update_slice,
         so the whole generate() scan compiles once (no growing
-        sequences under jit, the TPU-native spelling of a KV cache)."""
+        sequences under jit, the TPU-native spelling of a KV cache).
+
+        ``tp=True`` (with a >1 ``model``-axis ``mesh``) shards the
+        buffers over attention heads — each device holds the K/V slabs
+        for ITS heads only, matching the column-parallel wq/wk/wv of
+        :meth:`param_shardings`, so serving HBM for the cache also
+        scales down 1/tp."""
         L = max_len or self.max_len
         dh = self.dim // self.heads
         buf = jnp.zeros((batch, L, self.heads, dh), dtype)
+        _, head_axis = self._tp_hooks(mesh, tp)
+        if head_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(mesh, P(None, None, head_axis, None))
+            buf = (jax.lax.with_sharding_constraint(buf, sh)
+                   if isinstance(buf, jax.core.Tracer)
+                   else jax.device_put(buf, sh))
         return [{"k": buf, "v": buf} for _ in range(self.layers)]
 
-    def decode_step(self, params, tok, cache, pos):
+    def decode_step(self, params, tok, cache, pos, *, mesh=None,
+                    tp: bool = False):
         """One incremental step: token ids ``tok`` [B] at position
         ``pos`` (traced scalar) → (logits [B, vocab], updated cache).
 
@@ -409,10 +441,16 @@ class TinyCausalLM:
         0..pos (oracle-pinned against :meth:`apply` in
         tests/test_transformer.py). MoE blocks are unsupported here
         (top-1 routing is trainable batch machinery; decode serving
-        for experts would dispatch per token — not built)."""
+        for experts would dispatch per token — not built).
+
+        ``tp=True`` runs the step tensor-parallel: q/k/v and the cache
+        writes stay sharded over heads on the ``model`` axis (same
+        constraints as :meth:`apply`), so a model whose params exceed
+        one chip's HBM decodes without ever gathering them."""
         if self.experts:
             raise NotImplementedError(
                 "KV-cache decode for MoE blocks not supported")
+        tp_constrain, head_axis = self._tp_hooks(mesh, tp)
         cache_len = cache[0]["k"].shape[1]
         try:  # concrete pos (the eager step-by-step pattern): loud OOB
             if int(pos) >= cache_len:
@@ -437,6 +475,11 @@ class TinyCausalLM:
                 vc = jax.lax.dynamic_update_slice_in_dim(
                     cache[layer]["v"], v_t.astype(cache[layer]["v"].dtype),
                     pos, axis=1)
+                # keep the updated cache sharded over heads — without
+                # the pin GSPMD may gather the whole cache to satisfy
+                # the replicated-output default of the update-slice
+                kc = tp_constrain(kc, (None, None, head_axis, None))
+                vc = tp_constrain(vc, (None, None, head_axis, None))
                 new_cache.append({"k": kc, "v": vc})
                 scores = jnp.einsum("bqhd,bshd->bhqs", q, kc) * scale
                 live = jnp.arange(kc.shape[1]) <= pos      # [S]
@@ -449,12 +492,13 @@ class TinyCausalLM:
 
         for i in range(self.layers):
             x = self._decoder_block(x, params[f"block_{i}"],
-                                    cached_attn(i))
+                                    cached_attn(i), tp_constrain,
+                                    head_axis)
         x = _layer_norm(x[:, 0], params["final_norm"])
         return x @ params["embed"]["table"].T, new_cache
 
     def _gen_program(self, b: int, plen: int, max_new: int,
-                     temperature: float):
+                     temperature: float, *, mesh=None, tp: bool = False):
         """The jitted generate program for one static geometry
         ``(batch, PADDED prompt len, max_new, temperature)`` — the real
         prompt length is a TRACED argument, so every prompt that pads
@@ -472,7 +516,8 @@ class TinyCausalLM:
             def prefill_step(carry, t):
                 cache, best = carry
                 pos, tok = t
-                logits, cache = self.decode_step(params, tok, cache, pos)
+                logits, cache = self.decode_step(params, tok, cache, pos,
+                                                 mesh=mesh, tp=tp)
                 # logits ride the CARRY (only position real_plen-1's
                 # are used) — a stacked scan output would materialize
                 # [plen, B, vocab]
@@ -489,13 +534,15 @@ class TinyCausalLM:
             def gen_step(carry, t):
                 cache, tok = carry
                 pos, step_key = t
-                logits, cache = self.decode_step(params, tok, cache, pos)
+                logits, cache = self.decode_step(params, tok, cache, pos,
+                                                 mesh=mesh, tp=tp)
                 nxt = pick(logits, step_key)
                 return (cache, nxt), nxt
 
             # cache dtype follows the params (bf16 serving works)
             cache = self.init_cache(
-                b, plen + max_new, dtype=params["embed"]["table"].dtype)
+                b, plen + max_new, dtype=params["embed"]["table"].dtype,
+                mesh=mesh, tp=tp)
             (cache, logits), _ = jax.lax.scan(
                 prefill_step,
                 (cache, jnp.zeros((b, self.vocab),
@@ -511,7 +558,13 @@ class TinyCausalLM:
                 (real_plen + jnp.arange(max_new - 1), keys))
             return jnp.concatenate([first[:, None], rest.T], axis=1)
 
-        jit_key = (b, plen, max_new, float(temperature))
+        # a 2-D TP program and the 1-D program for the same geometry
+        # are DIFFERENT executables — the mesh topology joins the key
+        # (the same rail the AOT store applies via sharding tokens)
+        topo = (tuple(sorted((str(k), int(v))
+                             for k, v in mesh.shape.items()))
+                if tp and mesh is not None else None)
+        jit_key = (b, plen, max_new, float(temperature), topo)
         fn = self._gen_jits.get(jit_key)
         if fn is None:
             if len(self._gen_jits) >= 32:
@@ -536,7 +589,7 @@ class TinyCausalLM:
 
     def generate(self, params, prompt, max_new: int, *,
                  temperature: float = 0.0, rng=None,
-                 prompt_buckets=None):
+                 prompt_buckets=None, mesh=None, tp: bool = False):
         """Autoregressive continuation: ``prompt`` [B, P] int32 →
         [B, max_new] int32. One jitted program: prefill scans
         :meth:`decode_step` over the prompt (filling the cache),
@@ -551,7 +604,13 @@ class TinyCausalLM:
         serving with ragged prompt lengths compiles O(log max_len)
         programs instead of one per novel length — the real length
         stays a traced argument (masked prefill), so results match the
-        exact-length program for the real tokens."""
+        exact-length program for the real tokens.
+
+        ``tp=True`` (with a >1 ``model``-axis ``mesh``) decodes
+        tensor-parallel: pass params already placed by
+        :meth:`shard_params` and the whole prefill+decode program runs
+        with heads and the KV cache sharded — params larger than one
+        chip's HBM serve without ever being gathered."""
         prompt = jnp.asarray(prompt, jnp.int32)
         b, plen = prompt.shape
         total = plen + max_new
@@ -574,7 +633,8 @@ class TinyCausalLM:
                 [prompt, jnp.zeros((b, padded - plen), jnp.int32)],
                 axis=1)
         key = rng if rng is not None else jax.random.PRNGKey(0)
-        fn = self._gen_program(b, padded, max_new, float(temperature))
+        fn = self._gen_program(b, padded, max_new, float(temperature),
+                               mesh=mesh, tp=tp)
         args = (params, prompt, key, jnp.int32(plen))
         from tpudl.compile import aot_enabled, get_program_store
 
@@ -588,27 +648,43 @@ class TinyCausalLM:
 
     def precompile_generate(self, params, batch: int, prompt_len: int,
                             max_new: int, *, temperature: float = 0.0,
-                            prompt_buckets=None,
-                            block: bool = True) -> bool:
+                            prompt_buckets=None, mesh=None,
+                            tp: bool = False, block: bool = True) -> bool:
         """AOT-compile the generate program for one declared serving
         geometry THROUGH the program store (COMPILE.md): no prompt, no
         trace at serving time — and the serialized executable makes the
         next process's first request hit a restored program. With
         ``prompt_buckets`` the declared length snaps to its rung, so
-        one precompile covers every prompt in the bucket. Returns False
-        when the store is unarmed."""
+        one precompile covers every prompt in the bucket. ``tp=True``
+        warms the 2-D tensor-parallel program: the param avals carry
+        their :meth:`param_shardings` (or the live arrays' shardings),
+        so the store keys and restores the model-sharded executable
+        distinctly from the 1-D one. Returns False when the store is
+        unarmed."""
         from tpudl import compile as _compile
 
         if not _compile.aot_enabled():
             return False
+        _, head_axis = self._tp_hooks(mesh, tp)
         padded = self._gen_bucket(int(prompt_len), int(max_new),
                                   prompt_buckets)
         fn = self._gen_program(int(batch), padded, int(max_new),
-                               float(temperature))
+                               float(temperature), mesh=mesh, tp=tp)
         key = jax.random.PRNGKey(0)
+
+        def _aval(a, sh=None):
+            live = getattr(a, "sharding", None)
+            use = live if hasattr(live, "spec") else sh
+            return jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype,
+                                        sharding=use)
+
+        if head_axis is not None:
+            p_avals = jax.tree.map(_aval, params,
+                                   self.param_shardings(mesh))
+        else:
+            p_avals = jax.tree.map(_aval, params)
         avals = (
-            jax.tree.map(lambda a: jax.ShapeDtypeStruct(
-                jnp.shape(a), jnp.asarray(a).dtype), params),
+            p_avals,
             jax.ShapeDtypeStruct((int(batch), padded), jnp.int32),
             jax.ShapeDtypeStruct(jnp.shape(key),
                                  jnp.asarray(key).dtype),
