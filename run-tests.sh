@@ -56,6 +56,13 @@ echo "== 2-D mesh tensor parallelism subset (ISSUE 16 acceptance) =="
 # own line, not inside the full-suite noise.
 python -m pytest tests/test_mesh2d.py -q "$@"
 
+echo "== serve subset (ISSUE 17: continuous batching acceptance) =="
+# Target the serve module DIRECTLY (same rationale as the armed
+# concurrency subset above): the zero-retrace serve-loop sweep and
+# the overload-chaos burst run in subprocesses the tests spawn
+# themselves, and must fail loudly on their own line.
+python -m pytest tests/test_serve.py -q "$@"
+
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 

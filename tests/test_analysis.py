@@ -727,7 +727,8 @@ class TestRegistries:
             assert k.kind in ("int", "float", "bool", "str", "enum",
                               "path", "json")
             assert k.subsystem in ("frame", "data", "obs", "jobs",
-                                   "train", "zoo", "compile", "bench")
+                                   "train", "zoo", "compile", "serve",
+                                   "bench")
             assert k.help
         assert len(KNOB_NAMES) == len(KNOBS)  # no duplicate names
 

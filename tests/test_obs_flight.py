@@ -588,6 +588,67 @@ class TestDoctor:
 
         assert obs_main(["doctor", str(tmp_path)]) == 2
 
+    def test_overload_shed_single_host(self, tmp_path):
+        """A death under sustained typed rejects classifies as
+        capacity, not as a bug hunt: the serve plane was ANSWERING."""
+        p = _write_dump(tmp_path / "tpudl-dump-1000.json.gz", _payload(
+            reason="signal:15",
+            metrics={"serve.rejects": _counter(30),
+                     "serve.requests": _counter(200),
+                     "serve.deadline_sheds": _counter(4),
+                     "serve.queue_depth": {"type": "gauge",
+                                           "value": 64.0},
+                     "serve.queue_cap": {"type": "gauge",
+                                         "value": 64.0}}))
+        _merged, diag = obs_doctor.diagnose(p)
+        assert diag["classification"] == "overload_shed"
+        assert diag["suspect_stage"] == "admission"
+        assert any("30 of 230" in e and "13%" in e
+                   for e in diag["evidence"])
+        assert any("depth 64 of cap 64" in e for e in diag["evidence"])
+        assert any("4 request(s) shed on expired deadlines" in e
+                   for e in diag["evidence"])
+        assert any("TPUDL_SERVE_QUEUE_CAP" in e
+                   for e in diag["evidence"])
+
+    def test_overload_shed_multi_host_names_shedding_host(self,
+                                                          tmp_path):
+        _write_dump(tmp_path / "tpudl-dump-host0-1.json.gz", _payload(
+            reason="signal:15", process_index=0, process_count=2,
+            metrics={"serve.requests": _counter(100)}))
+        _write_dump(tmp_path / "tpudl-dump-host1-2.json.gz", _payload(
+            reason="signal:15", process_index=1, process_count=2,
+            pid=2000,
+            metrics={"serve.rejects": _counter(25),
+                     "serve.requests": _counter(80)}))
+        merged, diag = obs_doctor.diagnose(str(tmp_path))
+        assert merged["n_hosts"] == 2
+        assert diag["classification"] == "overload_shed"
+        assert diag["suspect_host"] == "1"
+
+    def test_few_rejects_are_not_overload(self, tmp_path):
+        """Below the sustained bar (>= 8 rejects AND >= 10% of offered
+        load) a handful of rejects must not reroute an unrelated
+        death."""
+        p = _write_dump(tmp_path / "tpudl-dump-1000.json.gz", _payload(
+            reason="signal:15",
+            metrics={"serve.rejects": _counter(3),
+                     "serve.requests": _counter(10)}))
+        _merged, diag = obs_doctor.diagnose(p)
+        assert diag["classification"] == "clean_external_kill"
+
+    def test_degraded_run_outranks_overload_shed(self, tmp_path):
+        """A mid-ladder death is the degradation story even when the
+        serve plane was also shedding — the rung trail explains WHY
+        admission was drowning."""
+        p = _write_dump(tmp_path / "tpudl-dump-1000.json.gz", _payload(
+            reason="degraded_exhausted",
+            metrics={"frame.degraded.rungs": _counter(2),
+                     "serve.rejects": _counter(30),
+                     "serve.requests": _counter(100)}))
+        _merged, diag = obs_doctor.diagnose(p)
+        assert diag["classification"] == "degraded_run"
+
 
 # -- restart forensics -----------------------------------------------------
 class TestRestartForensics:

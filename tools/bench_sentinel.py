@@ -55,16 +55,19 @@ class Metric:
     """One judged number: where it lives in a parsed record, how to
     recover it from a bare round tail, and how noisy it is allowed to
     be. ``wire_sensitive`` metrics are scored per-MB/s of the round's
-    own wire; all metrics are higher-is-better (seconds-shaped fields
-    are inverted into rates upstream)."""
+    own wire; metrics are higher-is-better (seconds-shaped fields are
+    inverted into rates upstream) unless ``lower_is_better`` flips the
+    verdicts (latency-shaped figures that read wrong inverted)."""
 
     def __init__(self, name: str, *, keys, tail_patterns=(),
-                 wire_sensitive: bool = False, floor: float = 0.15):
+                 wire_sensitive: bool = False, floor: float = 0.15,
+                 lower_is_better: bool = False):
         self.name = name
         self.keys = keys  # [(record_key, subfield-or-None), ...]
         self.tail_patterns = [re.compile(p) for p in tail_patterns]
         self.wire_sensitive = wire_sensitive
         self.floor = floor  # minimum relative noise band
+        self.lower_is_better = lower_is_better
 
     def from_record(self, record: dict):
         for key, field in self.keys:
@@ -207,6 +210,28 @@ METRICS = [
                           + r" images/sec",
                           r'"tf_cpu_baseline_images_per_sec": ' + _NUM],
            wire_sensitive=False, floor=0.25),
+    # serve plane (ISSUE 17): closed-loop continuous batching in one
+    # CPU child — no wire, no tunnel; scored raw like async_speedup.
+    # A QPS drop is the serve loop re-growing per-tick overhead
+    # (lost slot batching, retraces on admission, queue stalls) — a
+    # serving regression, never weather.
+    Metric("serve_sustained_qps",
+           keys=[("serve", "sustained_qps")],
+           tail_patterns=[r'"sustained_qps": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
+    # p99 end-to-end latency under the same closed loop: latency reads
+    # wrong inverted into a rate, so it is banded lower-is-better
+    Metric("serve_p99_ms",
+           keys=[("serve", "p99_ms")],
+           tail_patterns=[r'"p99_ms": ' + _NUM],
+           wire_sensitive=False, floor=0.30, lower_is_better=True),
+    # warm TTFT (program store restored before the first request): a
+    # rise means registration stopped warm-starting from the store —
+    # the TTFT = deserialization contract regressing
+    Metric("serve_warm_ttft_s",
+           keys=[("serve", "warm_ttft_s")],
+           tail_patterns=[r'"warm_ttft_s": ' + _NUM],
+           wire_sensitive=False, floor=0.30, lower_is_better=True),
 ]
 
 # every H2D figure a round can carry, in preference-free union (the
@@ -397,10 +422,15 @@ def evaluate_rounds(rounds: list[dict],
             "band_pct": round(100 * band, 1),
             "history_rounds": len(hist),
         })
-        if delta < -band:
+        # lower-is-better metrics keep delta_pct as the true relative
+        # change; only the verdict mapping flips
+        signed = -delta if m.lower_is_better else delta
+        if m.lower_is_better:
+            entry["lower_is_better"] = True
+        if signed < -band:
             entry["verdict"] = "regress"
             regressed.append(m.name)
-        elif delta > band:
+        elif signed > band:
             entry["verdict"] = "improve"
             improved.append(m.name)
         else:

@@ -32,7 +32,7 @@ class Knob:
     kind: str        # int | float | bool | str | enum | path | json
     default: str     # rendered default ("" = unset / derived)
     subsystem: str   # frame | data | obs | jobs | train | zoo |
-                     # compile | bench
+                     # compile | serve | bench
     help: str        # one line, present tense
 
 
@@ -259,6 +259,29 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_BENCH_COLD_N", "int", "256", "bench",
          "cold-start sub-bench row count (empty- vs warmed-program-"
          "store first-result subprocess A/B)"),
+    # -- serve plane (SERVE.md) ----------------------------------------
+    Knob("TPUDL_SERVE_QUEUE_CAP", "int", "64", "serve",
+         "request-queue admission cap: past this depth submits get a "
+         "typed reject (serve.rejects) instead of unbounded growth"),
+    Knob("TPUDL_SERVE_SLOTS", "int", "8", "serve",
+         "decode slots per model engine — the fixed leading dim of "
+         "the slot KV cache (one compiled step program per geometry)"),
+    Knob("TPUDL_SERVE_DEADLINE_S", "float", "", "serve",
+         "default per-request deadline (seconds from submit); expired "
+         "requests are shed typed before/while decoding (unset = "
+         "no deadline)"),
+    Knob("TPUDL_SERVE_HBM_MB", "float", "", "serve",
+         "admission budget on QUEUED payload bytes (MB): submits past "
+         "it get a typed hbm_budget reject (unset = off)"),
+    Knob("TPUDL_BENCH_SERVE_N", "int", "48", "bench",
+         "serve sub-bench total request count driven by the "
+         "closed-loop load generator"),
+    Knob("TPUDL_BENCH_SERVE_CLIENTS", "int", "4", "bench",
+         "serve sub-bench closed-loop client thread count (offered "
+         "concurrency)"),
+    Knob("TPUDL_BENCH_SERVE_P99_MS", "float", "2000", "bench",
+         "serve sub-bench p99 latency target (ms): sustained QPS is "
+         "judged only when the measured p99 meets it"),
 )
 
 KNOB_NAMES = frozenset(k.name for k in KNOBS)

@@ -118,6 +118,21 @@ LOCKS: tuple[LockDecl, ...] = (
              "instance", 20,
              "DeviceBatchCache entry map + LRU order + resident-byte "
              "and pin accounting (metrics published outside the lock)"),
+    LockDecl("serve.queue", "tpudl.serve.queue", "lock", "instance",
+             20,
+             "RequestQueue deque + payload-byte ledger (admission "
+             "decision; metrics and reject raise happen outside the "
+             "lock)"),
+    LockDecl("serve.registry", "tpudl.serve.registry", "lock",
+             "instance", 20,
+             "serve ModelRegistry name→entry map (serve.models gauge "
+             "published outside the lock)"),
+    LockDecl("serve.loadgen", "tpudl.serve.loadgen", "lock", "module",
+             20,
+             "closed-loop client tallies: request counter + latency/"
+             "TTFT/reject/shed lists (function-local; module scope = "
+             "one per run_closed_loop call; never held across a "
+             "submit/result wait)"),
     # -- rank 24: the two registries (their armed lockset checks file
     #    breadcrumbs into the flight recorder (25); they never nest
     #    with each other) ---------------------------------------------

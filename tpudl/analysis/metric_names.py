@@ -87,6 +87,9 @@ METRICS: tuple[Metric, ...] = (
            "max can never exceed dispatch_depth)"),
     Metric("mesh_pad_rows", "report-gauge",
            "SPMD pad rows sampled per mesh batch (PipelineReport)"),
+    Metric("slot_occupancy", "report-gauge",
+           "active decode slots over total, sampled per serve tick "
+           "(PipelineReport; feeds serve.batch_occupancy at finish)"),
     Metric("wire_batch_bytes", "report-gauge",
            "bytes shipped per batch (PipelineReport)"),
     # -- data: codecs + shard cache ------------------------------------
@@ -259,6 +262,51 @@ METRICS: tuple[Metric, ...] = (
     Metric("obs.roofline.collective_s", "gauge",
            "gap seconds attributed to model-axis collectives (2-D "
            "mesh runs with a measured comm share)"),
+    # -- serve plane (SERVE.md) ----------------------------------------
+    Metric("serve.requests", "counter",
+           "requests ADMITTED by the queue (offered load = requests "
+           "+ rejects)"),
+    Metric("serve.rejects", "counter",
+           "typed admission rejects (queue_full / hbm_budget) — the "
+           "load-shedding evidence obs doctor's overload_shed reads"),
+    Metric("serve.deadline_sheds", "counter",
+           "requests shed on an expired deadline (queued or "
+           "mid-decode, both typed DeadlineExceeded)"),
+    Metric("serve.queue_depth", "gauge",
+           "current request-queue depth (bounded by "
+           "TPUDL_SERVE_QUEUE_CAP)"),
+    Metric("serve.queue_cap", "gauge",
+           "the admission cap the queue was built with (at-death "
+           "evidence for overload_shed)"),
+    Metric("serve.inserts", "counter",
+           "prompt prefills inserted into decode slots"),
+    Metric("serve.evictions", "counter",
+           "slots freed EARLY (deadline shed, cancel, supervised "
+           "retry) — natural completions are serve.completed"),
+    Metric("serve.steps", "counter",
+           "slot decode-step dispatches (one compiled program per "
+           "step, every active slot rides it)"),
+    Metric("serve.tokens", "counter",
+           "tokens emitted across all slots"),
+    Metric("serve.tokens_per_s", "gauge",
+           "sustained token rate of the last finished serve session"),
+    Metric("serve.completed", "counter",
+           "requests finished with their full token budget"),
+    Metric("serve.batches", "counter",
+           "rung-bucketed dynamic batches dispatched for ragged "
+           "featurize/UDF payloads (RungBatcher)"),
+    Metric("serve.batch_occupancy", "gauge",
+           "real rows/slots over rung/slot capacity for the last "
+           "dispatch (session mean committed at finish; the "
+           "saturation SLO: > 0.5 under load)"),
+    Metric("serve.latency_ms", "histogram",
+           "end-to-end request latency, submit to completion "
+           "(p50/p99 are the serving SLO line)"),
+    Metric("serve.ttft_s", "histogram",
+           "time-to-first-token, submit to prefill completion (the "
+           "warm-start win: deserialization, not a 60s jit)"),
+    Metric("serve.models", "gauge",
+           "models registered in the serve registry"),
 )
 
 METRIC_NAMES = frozenset(m.name for m in METRICS if "*" not in m.name)

@@ -32,6 +32,14 @@ gang), merge per-host evidence and CLASSIFY the failure:
   degradation being CURRENT at death (the exhaustion dump, the
   supervisor's live heartbeat, or the newest report's ``degraded_to``)
   so one long-recovered fault never reroutes a later unrelated death;
+- ``overload_shed`` — the serve plane's admission control was
+  rejecting a sustained fraction of offered load when the process
+  died (``serve.rejects`` against ``serve.requests``, with the queue
+  depth/cap gauges as the at-death evidence): the death is — or rode
+  on — an overload the queue answered with TYPED rejects, not a hang.
+  Ordered after ``degraded_run`` (the ladder explains WHY capacity
+  shrank when both fired) and before the stall rules: a saturated
+  serve loop still beating its heartbeat is shedding, not stuck;
 - ``dispatch_slowdown`` — a stall (or dominant stage share) in
   ``dispatch``: the device/backend stopped answering or slowed;
 - ``clean_external_kill`` — a SIGTERM/SIGQUIT dump with no stall and
@@ -65,6 +73,13 @@ INFEED_STAGES = ("prepare", "h2d", "infeed", "infeed_wait", "decode",
 # the read attempts (an isolated corrupt file is noise, not a storm)
 STORM_MIN_EVENTS = 8
 STORM_MIN_FRAC = 0.10
+
+# overload_shed thresholds: same shape as the storm gate — absolute
+# floor (a handful of rejects on a tiny run is noise) AND a fraction
+# of OFFERED load (admitted + rejected), so a long healthy run with a
+# brief historical blip never reroutes an unrelated death
+SHED_MIN_EVENTS = 8
+SHED_MIN_FRAC = 0.10
 
 
 def load_dump(path: str) -> dict:
@@ -385,6 +400,54 @@ def classify(merged: dict) -> dict:
         return {"classification": "degraded_run",
                 "suspect_stage": suspect,
                 "suspect_host": suspect_host,
+                "evidence": evidence, "stage_rates": rates}
+
+    # 2d. overload shed: admission control was rejecting a sustained
+    #     fraction of offered load at death — the serve plane answered
+    #     pressure with typed rejects (the load-shedding contract),
+    #     and the actionable fact is capacity, not a bug hunt. Before
+    #     the stall rules: a saturated loop still beating its
+    #     heartbeat is shedding, not stuck.
+    rejects = sum(_metric_value(d, "serve.rejects")
+                  for d in hosts.values())
+    admitted = sum(_metric_value(d, "serve.requests")
+                   for d in hosts.values())
+    offered = rejects + admitted
+    if rejects >= SHED_MIN_EVENTS \
+            and rejects >= SHED_MIN_FRAC * max(offered, 1.0):
+        shed_host = None
+        for h, d in hosts.items():
+            if _metric_value(d, "serve.rejects"):
+                shed_host = h
+                break
+        depth = _metric_value(newest, "serve.queue_depth")
+        cap = _metric_value(newest, "serve.queue_cap")
+        sheds = sum(_metric_value(d, "serve.deadline_sheds")
+                    for d in hosts.values())
+        evidence.insert(0, (
+            f"admission control rejected {rejects:.0f} of "
+            f"{offered:.0f} offered requests "
+            f"({rejects / max(offered, 1.0):.0%}) — sustained "
+            "overload, shed by typed rejects"))
+        if cap:
+            evidence.append(
+                f"queue at death: depth {depth:.0f} of cap {cap:.0f}")
+        if sheds:
+            evidence.append(
+                f"{sheds:.0f} request(s) shed on expired deadlines")
+        if stalls:
+            last = stalls[-1]
+            evidence.append(
+                f"history: watchdog flagged {len(stalls)} stall(s); "
+                f"last: {last.get('name')} frozen {last.get('age_s')}s "
+                f"in stage {_stall_stage(last) or 'unknown'!r}")
+        evidence.append(
+            "the queue stayed bounded and clients got typed answers; "
+            "raise TPUDL_SERVE_QUEUE_CAP / TPUDL_SERVE_SLOTS or add "
+            "serving capacity (SERVE.md)")
+        return {"classification": "overload_shed",
+                "suspect_stage": "admission",
+                "suspect_host": shed_host or suspect_host,
                 "evidence": evidence, "stage_rates": rates}
 
     # 3/4. watchdog stalls: which side froze?

@@ -54,7 +54,7 @@ STATUS_PREFIX = "tpudl-status-"
 _METRIC_PREFIXES = ("train.", "hpo.", "udf.", "estimator.",
                     "obs.watchdog.", "obs.roofline.",
                     "frame.map_batches.", "frame.degraded.", "retry.",
-                    "data.hbm.", "compile.")
+                    "data.hbm.", "compile.", "serve.")
 
 
 def _status_dir() -> str | None:
@@ -171,6 +171,9 @@ def collect_status(roofline: bool = True) -> dict:
         comp = _compile_section(payload["metrics"])
         if comp is not None:
             payload["compile"] = comp
+        srv = _serve_section(payload["metrics"])
+        if srv is not None:
+            payload["serve"] = srv
     # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
     # contributor drops its section, never the whole status file
     except Exception:
@@ -245,6 +248,42 @@ def _compile_section(metrics: dict) -> dict | None:
         "aot_s": round(val("compile.aot_s") or 0.0, 3),
         "bucket_pad_rows": int(val("compile.bucket_pad_rows") or 0),
         "cache_disabled": int(val("compile.cache_disabled") or 0),
+    }
+
+
+def _serve_section(metrics: dict) -> dict | None:
+    """The status file's serve line (ISSUE 17): offered vs rejected
+    load, queue depth against its cap, slot occupancy, sustained token
+    rate and the latency SLO percentiles — a saturating server (depth
+    at cap, rejects climbing) or a TTFT regression is visible LIVE.
+    None when no serve metric ever published in this process."""
+    def val(name):
+        entry = metrics.get(name) or {}
+        v = entry.get("value")
+        return v if isinstance(v, (int, float)) else None
+
+    def pct(name, q):
+        v = (metrics.get(name) or {}).get(q)
+        return v if isinstance(v, (int, float)) else None
+
+    if val("serve.requests") is None and val("serve.queue_cap") is None:
+        return None
+    return {
+        "requests": int(val("serve.requests") or 0),
+        "rejects": int(val("serve.rejects") or 0),
+        "completed": int(val("serve.completed") or 0),
+        "deadline_sheds": int(val("serve.deadline_sheds") or 0),
+        "queue_depth": int(val("serve.queue_depth") or 0),
+        "queue_cap": int(val("serve.queue_cap") or 0),
+        "occupancy": (round(val("serve.batch_occupancy"), 3)
+                      if val("serve.batch_occupancy") is not None
+                      else None),
+        "tokens_per_s": (round(val("serve.tokens_per_s"), 1)
+                         if val("serve.tokens_per_s") is not None
+                         else None),
+        "p50_ms": pct("serve.latency_ms", "p50"),
+        "p99_ms": pct("serve.latency_ms", "p99"),
+        "models": int(val("serve.models") or 0),
     }
 
 
@@ -515,6 +554,25 @@ def render(statuses: list[dict], now: float | None = None) -> str:
             if comp.get("cache_disabled"):
                 line += (f"  CACHE-DISABLED "
                          f"x{comp['cache_disabled']}")
+            lines.append(line)
+        srv = st.get("serve") or {}
+        if srv:
+            line = (f"  serve:      req {srv.get('requests', 0)}"
+                    f"  done {srv.get('completed', 0)}"
+                    f"  queue {srv.get('queue_depth', 0)}"
+                    f"/{srv.get('queue_cap', 0)}")
+            if srv.get("rejects"):
+                line += f"  rejects {srv['rejects']}"
+            if srv.get("deadline_sheds"):
+                line += f"  sheds {srv['deadline_sheds']}"
+            if srv.get("occupancy") is not None:
+                line += f"  occ {100 * srv['occupancy']:.0f}%"
+            if srv.get("tokens_per_s") is not None:
+                line += f"  tok/s {srv['tokens_per_s']:.1f}"
+            if srv.get("p99_ms") is not None:
+                line += f"  p99 {srv['p99_ms']:.0f}ms"
+            if srv.get("models", 0) > 1:
+                line += f"  models {srv['models']}"
             lines.append(line)
         rl = st.get("roofline") or {}
         if rl.get("verdict"):

@@ -239,6 +239,21 @@ class PipelineReport:
             # armed, not silently collapsed to 1-D.
             _metrics.gauge("frame.mesh.model_axis").set(
                 int(self.config["mesh"].get("model") or 1))
+        # serve-session truth (ISSUE 17): a serve run's report commits
+        # the session-mean slot occupancy (the saturation SLO) and the
+        # sustained token rate — obs top's serve line and the roofline
+        # read these, and the per-step gauge's last value must not
+        # stand in for the whole session
+        if self.config.get("serve"):
+            with self._lock:
+                occ = self.gauges.get("slot_occupancy")
+                toks = int(self.calls.get("tokens", 0))
+            if occ is not None and occ.to_dict()["mean"] is not None:
+                _metrics.gauge("serve.batch_occupancy").set(
+                    occ.to_dict()["mean"])
+            if toks and self.wall_seconds:
+                _metrics.gauge("serve.tokens_per_s").set(
+                    toks / self.wall_seconds)
         _metrics.get_registry().maybe_flush()
 
     def report(self) -> dict:

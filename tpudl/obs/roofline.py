@@ -340,6 +340,13 @@ def analyze(report: dict | None = None, *,
             "aot_misses": calls.get("aot_misses"),
             "first_dispatch_s": calls.get("first_dispatch_s"),
             "cold_start_s": _cold_start_s(stages, calls, n_disp),
+            # serve-session shape (ISSUE 17): mean slot occupancy and
+            # the admission caps the session ran under — the advisor's
+            # queue_cap rec keys on rejecting load while slots idled
+            "serve": report.get("serve"),
+            "serve_queue_cap": report.get("queue_cap"),
+            "serve_occupancy": report.get("slot_occupancy_mean"),
+            "serve_rejects": _serve_rejects(report),
         })
     rr.advice = advise(rr)
     rr.verdict = _verdict(rr)
@@ -364,6 +371,19 @@ def _cold_start_s(stages: dict, calls: dict, n_disp: int) -> float | None:
     steady = max(0.0, total - first) / (n_disp - 1)
     cold = first - steady
     return cold if cold > 0 else None
+
+
+def _serve_rejects(report: dict) -> float | None:
+    """Admission rejects for a serve report, from the process registry
+    (the queue publishes there, not into the per-run report). None for
+    non-serve reports — the key must not imply serve semantics on an
+    executor run."""
+    if not report.get("serve"):
+        return None
+    from tpudl.obs import metrics as _m
+
+    v = float(_m.counter("serve.rejects").value)
+    return v or None
 
 
 def advise(rr: RooflineReport) -> list[dict]:
@@ -532,6 +552,25 @@ def advise(rr: RooflineReport) -> list[dict]:
                  f"of the run; if the params fit {new_tp}-way "
                  f"(TPUDL_MESH_MODEL={new_tp}), a narrower grid trades "
                  f"ICI hops back for local compute")
+    # 7) serve admission (ISSUE 17): the session REJECTED load while
+    #    decode slots sat idle — admission, not capacity, was the
+    #    limit. Advisory only (capacity knobs change admission
+    #    semantics, never autotuned); conservative saving: perfect
+    #    packing serves the same tokens in ~occ of the wall, claim
+    #    half of that.
+    if inp.get("serve") and (inp.get("serve_rejects") or 0) > 0:
+        occ = inp.get("serve_occupancy")
+        if occ is not None and float(occ) < 0.5:
+            cur_cap = int(inp.get("serve_queue_cap") or 0)
+            saved = rr.wall_s * (1.0 - float(occ)) * 0.5
+            _rec("queue_cap", cur_cap or "default",
+                 (2 * cur_cap) if cur_cap else "raise",
+                 saved,
+                 f"{inp['serve_rejects']:.0f} request(s) were rejected "
+                 f"while mean slot occupancy was {float(occ):.0%} — "
+                 f"the queue turned work away from idle slots; raise "
+                 f"TPUDL_SERVE_QUEUE_CAP (and/or TPUDL_SERVE_SLOTS) "
+                 f"so admission matches decode capacity (SERVE.md)")
     recs.sort(key=lambda r: -r["predicted_gain_pct"])
     return recs
 

@@ -1,0 +1,104 @@
+"""Multi-model serve registry with warm-start from the program store.
+
+Registration is where TTFT is won (SERVE.md): ``add_model`` builds the
+model's :class:`~tpudl.serve.slots.SlotDecoder` and — when the AOT
+store is armed — restores the persisted program table
+(``ensure_restored(block=True)``) and submits every serve-loop
+signature through ``precompile_serve``. A previously-served model's
+first token is then a DESERIALIZATION away, not a 60-second jit; the
+``bench serve`` warm arm pins the ratio (``serve_warm_ttft_s``).
+
+One instance lock (``serve.registry``) guards the name→entry map;
+the ``serve.models`` gauge publishes outside it.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpudl.obs import metrics as _metrics
+from tpudl.serve.slots import SlotDecoder
+from tpudl.testing import tsan as _tsan
+
+__all__ = ["ModelEntry", "ModelRegistry"]
+
+
+class ModelEntry:
+    """One registered model: its engine plus warm-start forensics."""
+
+    __slots__ = ("name", "model", "params", "engine",
+                 "warm_signatures", "warm_s")
+
+    def __init__(self, name: str, model, params, engine: SlotDecoder,
+                 warm_signatures: int, warm_s: float):
+        self.name = name
+        self.model = model
+        self.params = params
+        self.engine = engine
+        self.warm_signatures = warm_signatures
+        self.warm_s = warm_s
+
+
+class ModelRegistry:
+    """Name → :class:`ModelEntry` map shared by one server."""
+
+    def __init__(self):
+        self._lock = _tsan.named_lock("serve.registry")
+        self._entries: dict[str, ModelEntry] = {}
+
+    def add_model(self, name: str, model, params, *,
+                 slots: int | None = None,
+                 cache_len: int | None = None,
+                 temperature: float = 0.0, prompt_buckets=True,
+                 prompt_rungs=None, mesh=None, tp: bool = False,
+                 warm: bool = True) -> ModelEntry:
+        """Build the engine for ``model`` and (``warm=True``, store
+        armed) AOT-warm its serve programs. ``prompt_rungs`` overrides
+        the warmed prefill signature set; default is every ladder rung
+        the fixed cache can admit (an over-approximation costs compile
+        time once, never correctness — a rung missed here compiles on
+        first use like any store miss)."""
+        engine = SlotDecoder(model, params, slots=slots,
+                             cache_len=cache_len,
+                             temperature=temperature,
+                             prompt_buckets=prompt_buckets, mesh=mesh,
+                             tp=tp)
+        warm_n, warm_s = 0, 0.0
+        if warm:
+            t0 = time.perf_counter()
+            if prompt_rungs is None:
+                prompt_rungs = (
+                    engine._ladder.rungs_up_to(engine.cache_len - 1)
+                    if engine._ladder else [])
+            if prompt_rungs:
+                warm_n = model.precompile_serve(
+                    params, slots=engine.slots,
+                    cache_len=engine.cache_len,
+                    prompt_rungs=prompt_rungs,
+                    temperature=engine.temperature, mesh=mesh, tp=tp,
+                    block=True)
+            warm_s = time.perf_counter() - t0
+        entry = ModelEntry(str(name), model, params, engine, warm_n,
+                           warm_s)
+        with self._lock:
+            self._entries[entry.name] = entry
+            count = len(self._entries)
+        _metrics.gauge("serve.models").set(count)
+        return entry
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            try:
+                return self._entries[name]
+            except KeyError:
+                raise KeyError(
+                    f"model {name!r} not registered (have: "
+                    f"{sorted(self._entries)})") from None
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._entries)
+
+    def entries(self) -> list:
+        with self._lock:
+            return list(self._entries.values())

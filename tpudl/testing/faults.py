@@ -31,7 +31,15 @@ watch it recover. This module is that demand side:
     deterministic stand-in for a high-latency dispatch round-trip
     (the async-executor overlap acceptance tests inject a per-dispatch
     tunnel this way and measure how much of it the D-deep window
-    hides).
+    hides). ``slow_client`` wraps it for the serve plane's
+    ``serve.client`` point: a stalling client whose requests age in
+    the queue exercises the deadline-shed path;
+  - ``burst`` — inject ``count`` extra requests in one serve tick:
+    the firing site (the serve load generator at ``serve.tick``)
+    receives the count as ``fire``'s return value and submits that
+    many requests back-to-back, driving admission control past queue
+    capacity deterministically (the ``overload_shed`` chaos
+    acceptance).
 
 Plans arm process-locally (``with plan.armed(): ...``) or across a
 process boundary via ``TPUDL_FAULT_PLAN`` (JSON; the kill-mid-epoch
@@ -120,10 +128,11 @@ class _Rule:
         self.point = str(spec["point"])
         self.action = str(spec.get("action", "raise"))
         if self.action not in ("raise", "sigterm", "corrupt", "unlink",
-                               "delay", "oom"):
+                               "delay", "oom", "burst"):
             raise ValueError(f"unknown fault action {self.action!r}")
         self.seconds = float(spec.get("seconds", 0.0))
         self.nbytes = int(spec.get("bytes", 0) or 0)  # oom: alloc size
+        self.count = int(spec.get("count", 0) or 0)   # burst: extra reqs
         # triggers — all optional, all must match when present:
         self.at_call = spec.get("at_call")        # exactly the Nth call
         self.first_calls = spec.get("first_calls")  # calls 1..K
@@ -155,6 +164,8 @@ class _Rule:
             d["seconds"] = self.seconds
         if self.nbytes:
             d["bytes"] = self.nbytes
+        if self.count:
+            d["count"] = self.count
         if self.when:
             d["when"] = self.when
         return d
@@ -209,6 +220,28 @@ class FaultPlan:
         if first_calls is not None:
             rule["first_calls"] = int(first_calls)
         return cls([rule])
+
+    @classmethod
+    def burst(cls, count: int, point: str = "serve.tick",
+              at_call: int | None = None) -> "FaultPlan":
+        """Inject ``count`` extra requests in ONE serve tick (every
+        firing of ``point``, or only its ``at_call``-th): the firing
+        site receives the count as the return value and submits that
+        many requests back-to-back — the deterministic overload spike
+        the admission-control acceptance drives past queue capacity."""
+        rule: dict = {"point": point, "action": "burst",
+                      "count": int(count)}
+        if at_call is not None:
+            rule["at_call"] = int(at_call)
+        return cls([rule])
+
+    @classmethod
+    def slow_client(cls, seconds: float, point: str = "serve.client",
+                    first_calls: int | None = None) -> "FaultPlan":
+        """A client that stalls ``seconds`` before each submit (or only
+        its first K) — the deadline-shed path's chaos shape: requests
+        age in the queue while the slow client dribbles load."""
+        return cls.delay(point, seconds, first_calls=first_calls)
 
     @classmethod
     def oom(cls, point: str = "frame.dispatch", at_call: int = 1,
@@ -277,7 +310,13 @@ class FaultPlan:
             # slow tunnel round-trip would, so overlap tests measure
             # the executor, not the harness
             time.sleep(matched.seconds)
-            return
+            return None
+        if matched.action == "burst":
+            # chaos input, not a failure: the COUNT is returned to the
+            # firing site (the serve load generator submits that many
+            # extra requests in the same tick) so admission control is
+            # tested by pressure, not by mocking the queue
+            return matched.count
         if matched.action == "oom":
             raise oom_error(matched.nbytes or (2 << 30),
                             point=f"{point} call {matched.calls}")
@@ -360,7 +399,9 @@ def install_from_env() -> FaultPlan | None:
 def fire(point: str, **ctx):
     """The production-side hook: a no-op global check unless a plan is
     armed (never add work on this line — it sits on executor and train
-    hot paths)."""
+    hot paths). Returns the matched rule's payload for data-bearing
+    actions (``burst`` → its count), else ``None``."""
     plan = _PLAN
     if plan is not None:
-        plan.fire(point, **ctx)
+        return plan.fire(point, **ctx)
+    return None

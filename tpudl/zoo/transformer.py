@@ -497,6 +497,248 @@ class TinyCausalLM:
         x = _layer_norm(x[:, 0], params["final_norm"])
         return x @ params["embed"]["table"].T, new_cache
 
+    def decode_step_slots(self, params, tok, cache, pos, *, mesh=None,
+                          tp: bool = False):
+        """One decode step across ``S`` INDEPENDENT slots: token ids
+        ``tok`` [S] at PER-SLOT positions ``pos`` [S] (traced) →
+        (logits [S, vocab], updated cache) over a fixed-geometry
+        ``[S, L, heads, head_dim]`` KV cache.
+
+        The continuous-batching primitive (SERVE.md): each slot is one
+        in-flight sequence at its own depth, so a churning request mix
+        decodes through ONE compiled program — insert/evict are host
+        bookkeeping plus a full-row cache write, never a shape change.
+        Same block math as :meth:`decode_step` (shared
+        :meth:`_decoder_block`); only the cache write (vmapped per-slot
+        ``dynamic_update_slice``) and the mask (per-slot ``keys <=
+        pos[s]``) differ. Rows are independent in every reduction, so a
+        slot's logits are bitwise those of a batch-1 serial decode at
+        the same position — the parity contract tests/test_serve.py
+        pins. Inactive slots ride along on stale state: their write at
+        ``pos[s]`` lands in a row whose NEXT insert overwrites the
+        whole row before anything reads it (the same
+        overwrite-before-attend invariant as :meth:`_gen_program`'s pad
+        slots), and their logits are discarded host-side."""
+        if self.experts:
+            raise NotImplementedError(
+                "KV-cache decode for MoE blocks not supported")
+        tp_constrain, head_axis = self._tp_hooks(mesh, tp)
+        x = params["embed"]["table"][tok][:, None]         # [S, 1, D]
+        new_cache = []
+
+        def cached_attn(layer):
+            def attn(q, k_t, v_t):  # all [S, 1, H, Dh] from the block
+                scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+
+                def write(buf, t):
+                    # per-slot depth: each row gets its own update
+                    # position (the scalar-pos update of decode_step,
+                    # vmapped over the slot dim)
+                    return jax.vmap(
+                        lambda row, upd, p:
+                        jax.lax.dynamic_update_slice_in_dim(
+                            row, upd, p, axis=0))(
+                        buf, t.astype(buf.dtype), pos)
+
+                kc = write(cache[layer]["k"], k_t)
+                vc = write(cache[layer]["v"], v_t)
+                kc = tp_constrain(kc, (None, None, head_axis, None))
+                vc = tp_constrain(vc, (None, None, head_axis, None))
+                new_cache.append({"k": kc, "v": vc})
+                scores = jnp.einsum("bqhd,bshd->bhqs", q, kc) * scale
+                live = (jnp.arange(kc.shape[1])[None, :]
+                        <= pos[:, None])                   # [S, L]
+                scores = jnp.where(live[:, None, None, :], scores,
+                                   -jnp.inf)
+                w = jax.nn.softmax(scores, axis=-1)
+                return jnp.einsum("bhqs,bshd->bqhd", w, vc)
+
+            return attn
+
+        for i in range(self.layers):
+            x = self._decoder_block(x, params[f"block_{i}"],
+                                    cached_attn(i), tp_constrain,
+                                    head_axis)
+        x = _layer_norm(x[:, 0], params["final_norm"])
+        return x @ params["embed"]["table"].T, new_cache
+
+    def _slot_step_program(self, slots: int, cache_len: int,
+                           temperature: float, *, mesh=None,
+                           tp: bool = False):
+        """The jitted one-token-per-slot decode program for one static
+        serve geometry ``(slots, cache_len, temperature)`` — the ONE
+        program a continuous-batching serve loop dispatches forever:
+        ``(params, cache, tok [S], pos [S], keys [S], steps [S])`` →
+        ``(next_tok [S], cache')``. Sampling folds each slot's key with
+        ITS generation-step index, matching :meth:`_gen_program`'s
+        per-step ``fold_in`` so a sampled slot reproduces the serial
+        token stream."""
+
+        def run(params, cache, tok, pos, keys, steps):
+            logits, cache = self.decode_step_slots(
+                params, tok, cache, pos, mesh=mesh, tp=tp)
+            if temperature > 0:
+                nxt = jax.vmap(
+                    lambda lg, kk, st: jax.random.categorical(
+                        jax.random.fold_in(kk, st),
+                        lg / temperature, axis=-1))(
+                    logits, keys, steps).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, cache
+
+        topo = (tuple(sorted((str(k), int(v))
+                             for k, v in mesh.shape.items()))
+                if tp and mesh is not None else None)
+        jit_key = ("slot_step", slots, cache_len, float(temperature),
+                   topo)
+        fn = self._gen_jits.get(jit_key)
+        if fn is None:
+            if len(self._gen_jits) >= 32:
+                self._gen_jits.pop(next(iter(self._gen_jits)))
+            fn = self._gen_jits[jit_key] = jax.jit(run)
+        return fn
+
+    def _slot_prefill_program(self, plen: int, slots: int,
+                              cache_len: int, temperature: float, *,
+                              mesh=None, tp: bool = False):
+        """The jitted insert program for one static ``(PADDED prompt
+        len, slots, cache_len, temperature)``: scan the prompt through
+        :meth:`decode_step` on a fresh batch-1 row cache of the SLOT
+        length, pick the first token at ``real_plen - 1`` (the
+        :meth:`_gen_program` logits-carry), then write the whole row
+        into the slot cache at a TRACED slot index —
+        ``(params, cache, prompt [1, plen], key, real_plen, slot)`` →
+        ``(first_tok [1], cache')``. Bucketed prompts share programs:
+        O(log n) prefill signatures serve every ragged admission
+        (COMPILE.md), and the full-row write wipes any stale state of
+        the slot's previous occupant before a single step attends it."""
+
+        def run(params, cache, prompt, key, real_plen, slot):
+            tp_constrain, head_axis = self._tp_hooks(mesh, tp)
+            dtype = params["embed"]["table"].dtype
+            row = self.init_cache(1, cache_len, dtype=dtype, mesh=mesh,
+                                  tp=tp)
+
+            def prefill_step(carry, t):
+                rc, best = carry
+                p, t_ = t
+                logits, rc = self.decode_step(params, t_, rc, p,
+                                              mesh=mesh, tp=tp)
+                best = jnp.where(p == real_plen - 1, logits, best)
+                return (rc, best), None
+
+            (row, logits), _ = jax.lax.scan(
+                prefill_step,
+                (row, jnp.zeros((1, self.vocab), dtype)),
+                (jnp.arange(plen), prompt.T))
+            if temperature > 0:
+                first = jax.random.categorical(
+                    jax.random.fold_in(key, 0), logits / temperature,
+                    axis=-1).astype(jnp.int32)
+            else:
+                first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_cache = []
+            for layer in range(self.layers):
+                kc = jax.lax.dynamic_update_slice(
+                    cache[layer]["k"],
+                    row[layer]["k"].astype(cache[layer]["k"].dtype),
+                    (slot, 0, 0, 0))
+                vc = jax.lax.dynamic_update_slice(
+                    cache[layer]["v"],
+                    row[layer]["v"].astype(cache[layer]["v"].dtype),
+                    (slot, 0, 0, 0))
+                kc = tp_constrain(kc, (None, None, head_axis, None))
+                vc = tp_constrain(vc, (None, None, head_axis, None))
+                new_cache.append({"k": kc, "v": vc})
+            return first, new_cache
+
+        topo = (tuple(sorted((str(k), int(v))
+                             for k, v in mesh.shape.items()))
+                if tp and mesh is not None else None)
+        jit_key = ("slot_prefill", plen, slots, cache_len,
+                   float(temperature), topo)
+        fn = self._gen_jits.get(jit_key)
+        if fn is None:
+            if len(self._gen_jits) >= 32:
+                self._gen_jits.pop(next(iter(self._gen_jits)))
+            fn = self._gen_jits[jit_key] = jax.jit(run)
+        return fn
+
+    def precompile_serve(self, params, *, slots: int, cache_len: int,
+                         prompt_rungs, temperature: float = 0.0,
+                         mesh=None, tp: bool = False,
+                         block: bool = True) -> int:
+        """AOT-compile the serve-loop programs (one slot-step program +
+        one prefill program per prompt rung) through the program store,
+        so a fresh serving process's time-to-first-token is a
+        deserialization, not a trace+compile (COMPILE.md; the
+        tpudl.serve registry calls this at model registration).
+        Returns the number of signatures submitted; 0 when the store is
+        unarmed."""
+        from tpudl import compile as _compile
+
+        if not _compile.aot_enabled():
+            return 0
+        _, head_axis = self._tp_hooks(mesh, tp)
+        dh = self.dim // self.heads
+        dtype = jnp.asarray(params["embed"]["table"]).dtype
+        cache_sh = None
+        if head_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            cache_sh = NamedSharding(mesh, P(None, None, head_axis,
+                                             None))
+
+        def _aval(a, sh=None):
+            live = getattr(a, "sharding", None)
+            use = live if hasattr(live, "spec") else sh
+            return jax.ShapeDtypeStruct(jnp.shape(a),
+                                        jnp.asarray(a).dtype,
+                                        sharding=use)
+
+        if head_axis is not None:
+            p_avals = jax.tree.map(_aval, params,
+                                   self.param_shardings(mesh))
+        else:
+            p_avals = jax.tree.map(_aval, params)
+        buf = jax.ShapeDtypeStruct((int(slots), int(cache_len),
+                                    self.heads, dh), dtype,
+                                   sharding=cache_sh)
+        cache_avals = [{"k": buf, "v": buf} for _ in range(self.layers)]
+        key = jax.random.PRNGKey(0)
+        key_dtype = jnp.asarray(key).dtype
+        key_shape = jnp.shape(key)
+        store = _compile.get_program_store()
+        store.ensure_restored(block=True)
+        n = 0
+        step_fn = self._slot_step_program(int(slots), int(cache_len),
+                                          float(temperature), mesh=mesh,
+                                          tp=tp)
+        step_avals = (
+            p_avals, cache_avals,
+            jax.ShapeDtypeStruct((int(slots),), jnp.int32),
+            jax.ShapeDtypeStruct((int(slots),), jnp.int32),
+            jax.ShapeDtypeStruct((int(slots),) + key_shape, key_dtype),
+            jax.ShapeDtypeStruct((int(slots),), jnp.int32),
+        )
+        if store.compile_signature(step_fn, step_avals, block=block):
+            n += 1
+        for rung in sorted({int(r) for r in prompt_rungs}):
+            fill_fn = self._slot_prefill_program(
+                rung, int(slots), int(cache_len), float(temperature),
+                mesh=mesh, tp=tp)
+            fill_avals = (
+                p_avals, cache_avals,
+                jax.ShapeDtypeStruct((1, rung), jnp.int32),
+                jax.ShapeDtypeStruct(key_shape, key_dtype),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            )
+            if store.compile_signature(fill_fn, fill_avals, block=block):
+                n += 1
+        return n
+
     def _gen_program(self, b: int, plen: int, max_new: int,
                      temperature: float, *, mesh=None, tp: bool = False):
         """The jitted generate program for one static geometry
