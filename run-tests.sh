@@ -63,6 +63,13 @@ echo "== serve subset (ISSUE 17: continuous batching acceptance) =="
 # themselves, and must fail loudly on their own line.
 python -m pytest tests/test_serve.py -q "$@"
 
+echo "== serve telemetry subset (ISSUE 18: traces + SLO acceptance) =="
+# Target the telemetry module DIRECTLY (same rationale as the armed
+# concurrency subset above): the segment-sum contract, the windowed-
+# vs-loadgen percentile agreement and the slo_burn doctor fixtures
+# must fail loudly on their own line.
+python -m pytest tests/test_serve_telemetry.py -q "$@"
+
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 

@@ -232,6 +232,14 @@ METRICS = [
            keys=[("serve", "warm_ttft_s")],
            tail_patterns=[r'"warm_ttft_s": ' + _NUM],
            wire_sensitive=False, floor=0.30, lower_is_better=True),
+    # windowed p99 from the SLO engine (ISSUE 18): the same closed
+    # loop read through the recent-window plane instead of lifetime
+    # tallies — a rise with a flat serve_p99_ms means the WINDOW math
+    # (or the trace stamps feeding it) regressed, not the serving
+    Metric("serve_slo_window_p99_ms",
+           keys=[("serve", "slo_window_p99_ms")],
+           tail_patterns=[r'"slo_window_p99_ms": ' + _NUM],
+           wire_sensitive=False, floor=0.30, lower_is_better=True),
 ]
 
 # every H2D figure a round can carry, in preference-free union (the

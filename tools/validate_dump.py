@@ -25,7 +25,7 @@ import sys
 
 _NUM = (int, float)
 SCHEMA = "tpudl-flight-dump"
-VERSION = 1
+VERSION = 2
 
 # key -> required python types of the top-level payload
 _TOP_KEYS = {
@@ -56,12 +56,20 @@ _TOP_KEYS = {
 # orders of magnitude past these means an unbounded recorder)
 _RING_CAPS = {"batches": 4096, "errors": 4096, "stalls": 1024,
               "metric_ticks": 4096, "restarts": 64, "events": 64,
-              "spans": 65536}
+              "requests": 1024, "spans": 65536}
 _BATCH_KEYS = {"ts": _NUM, "stage": str, "index": int,
                "shapes": list, "dtypes": list}
 _ERROR_KEYS = {"ts": _NUM, "kind": str, "message": str}
 _STALL_KEYS = {"ts": _NUM, "name": str, "age_s": _NUM, "stall_s": _NUM,
                "stacks": dict}
+# the serve request ring (version >= 2): one descriptor per TERMINAL
+# request — ids, sizes and millisecond timings, NEVER prompt content
+_REQUEST_KEYS = {"ts": _NUM, "trace_id": (str, type(None)),
+                 "model": str, "prompt_len": int, "max_new": int,
+                 "outcome": str, "latency_ms": (int, float, type(None)),
+                 "segments": (dict, type(None))}
+# keys that would mean a request descriptor leaked content
+_REQUEST_FORBIDDEN = ("prompt", "tokens", "text")
 
 
 def _check_keys(obj: dict, spec: dict, where: str) -> list[str]:
@@ -86,6 +94,11 @@ def validate_payload(payload) -> list[str]:
             and payload["version"] > VERSION:
         errs.append(f"dump: version {payload['version']} is newer than "
                     f"this validator ({VERSION})")
+    # the request ring arrived with version 2; a v1 dump without it is
+    # still valid (back-compat), a v2 dump must carry it
+    if isinstance(payload.get("version"), int) \
+            and payload["version"] >= 2:
+        errs.extend(_check_keys(payload, {"requests": list}, "dump"))
     # ring bounds: a leaked (unbounded) recorder shows up here
     for ring, cap in _RING_CAPS.items():
         entries = payload.get(ring)
@@ -115,6 +128,27 @@ def validate_payload(payload) -> list[str]:
             errs.extend(_check_keys(s, _STALL_KEYS, f"stalls[{i}]"))
         else:
             errs.append(f"stalls[{i}]: not an object")
+    for i, r in enumerate(payload.get("requests") or []):
+        if not isinstance(r, dict):
+            errs.append(f"requests[{i}]: not an object")
+            continue
+        errs.extend(_check_keys(r, _REQUEST_KEYS, f"requests[{i}]"))
+        # never-content contract, request flavor: a descriptor carries
+        # lengths and timings — token/prompt payloads are a leak
+        for k in _REQUEST_FORBIDDEN:
+            if k in r:
+                errs.append(f"requests[{i}].{k}: request descriptors "
+                            "must not carry prompt/token content")
+        for k, v in r.items():
+            if isinstance(v, list) and len(v) > 64:
+                errs.append(f"requests[{i}].{k}: {len(v)}-element list "
+                            "(descriptors must not carry data)")
+        segs = r.get("segments")
+        if isinstance(segs, dict):
+            for k, v in segs.items():
+                if not isinstance(v, _NUM):
+                    errs.append(f"requests[{i}].segments.{k}: "
+                                f"{type(v).__name__} is not numeric")
     # metrics reuse the sink's typed-dict schema when the validator is
     # importable (a wheel install may not ship tools/)
     try:

@@ -106,6 +106,44 @@ def validate_payload(payload) -> list[str]:
         for k in ("age_s", "beats"):
             if not isinstance(hb.get(k), _NUM):
                 errs.append(f"heartbeats[{name}]: missing/invalid {k}")
+    srv = payload.get("serve")
+    if srv is not None and not isinstance(srv, dict):
+        errs.append("serve: not an object")
+    elif isinstance(srv, dict):
+        for k in ("requests", "rejects", "completed", "queue_depth",
+                  "queue_cap"):
+            if not isinstance(srv.get(k), _NUM):
+                errs.append(f"serve.{k}: missing/invalid")
+        slo = srv.get("slo")
+        if slo is not None and not isinstance(slo, dict):
+            errs.append("serve.slo: not an object")
+        elif isinstance(slo, dict):
+            for k in ("target_ms", "window_s", "window_n"):
+                if not isinstance(slo.get(k), _NUM):
+                    errs.append(f"serve.slo.{k}: missing/invalid")
+            for k in ("window_p50_ms", "window_p99_ms", "availability",
+                      "burn_short", "burn_long", "window_qps"):
+                v = slo.get(k)
+                if v is not None and not isinstance(v, _NUM):
+                    errs.append(f"serve.slo.{k}: {type(v).__name__} "
+                                "is not numeric")
+            av = slo.get("availability")
+            if isinstance(av, _NUM) and not 0 <= av <= 1.0001:
+                errs.append(f"serve.slo.availability: {av!r} is not "
+                            "a fraction")
+            samples = slo.get("window_samples_ms")
+            if samples is not None:
+                # bounded sample tail: the HUD contract again — a
+                # whole latency ring in the status file is a leak
+                if not isinstance(samples, list) or len(samples) > 256:
+                    errs.append("serve.slo.window_samples_ms: must be "
+                                "a bounded list (<= 256 entries)")
+                else:
+                    for j, v in enumerate(samples):
+                        if not isinstance(v, _NUM):
+                            errs.append(
+                                f"serve.slo.window_samples_ms[{j}]: "
+                                f"{type(v).__name__} is not numeric")
     rl = payload.get("roofline")
     if isinstance(rl, dict):
         attr = rl.get("gap_attribution")

@@ -126,6 +126,10 @@ KNOBS: tuple[Knob, ...] = (
          "flight recorder: stall-event ring capacity"),
     Knob("TPUDL_FLIGHT_TICKS", "int", "32", "obs",
          "flight recorder: metric-tick ring capacity"),
+    Knob("TPUDL_FLIGHT_REQUESTS", "int", "64", "obs",
+         "flight recorder: completed-serve-request descriptor ring "
+         "capacity (trace ids + segment timings, never prompt "
+         "content)"),
     Knob("TPUDL_FLIGHT_SPANS", "int", "512", "obs",
          "span-ring tail length embedded in a dump"),
     Knob("TPUDL_FAULTHANDLER", "bool", "0", "obs",
@@ -282,6 +286,27 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TPUDL_BENCH_SERVE_P99_MS", "float", "2000", "bench",
          "serve sub-bench p99 latency target (ms): sustained QPS is "
          "judged only when the measured p99 meets it"),
+    # -- serve telemetry (ISSUE 18: lifecycle traces + SLO engine) -----
+    Knob("TPUDL_SERVE_TRACE", "bool", "1", "serve",
+         "request lifecycle tracing: 0 disarms ReqTrace entirely "
+         "(every stamp site gates on it; the <5% overhead guard "
+         "measures this toggle)"),
+    Knob("TPUDL_SERVE_TRACE_EVENTS", "int", "64", "serve",
+         "per-request trace event cap (bounded stamp list; terminal "
+         "stamps always land inside it)"),
+    Knob("TPUDL_SERVE_TRACE_CADENCE", "int", "16", "serve",
+         "decode cadence: stamp every N-th decoded token into the "
+         "request trace"),
+    Knob("TPUDL_SERVE_SLO_P99_MS", "float", "500", "serve",
+         "the latency objective (ms): windowed availability and burn "
+         "rate (serve.slo.*) are computed against it"),
+    Knob("TPUDL_SERVE_SLO_WINDOW_S", "float", "30", "serve",
+         "short SLO window (seconds); the long burn window is 10x "
+         "this (the classic multi-window pairing)"),
+    Knob("TPUDL_SERVE_SLO_TAIL_K", "float", "4", "serve",
+         "tail-exemplar gate: a completed request slower than k x the "
+         "windowed median is captured with its segment breakdown into "
+         "the error ring"),
 )
 
 KNOB_NAMES = frozenset(k.name for k in KNOBS)

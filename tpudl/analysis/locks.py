@@ -154,7 +154,11 @@ LOCKS: tuple[LockDecl, ...] = (
     LockDecl("obs.flight.recorder", "tpudl.obs.flight", "lock",
              "instance", 25,
              "FlightRecorder evidence rings (batches/errors/stalls/"
-             "ticks/restarts/events) + dumped-paths list"),
+             "ticks/requests/restarts/events) + dumped-paths list"),
+    LockDecl("obs.slo.engine", "tpudl.obs.slo", "lock", "instance", 25,
+             "SloEngine windowed stamp ring + cached median + publish "
+             "throttle (gauges and exemplar writes happen outside "
+             "the lock)"),
     # -- rank 30: leaf scalar locks (never acquire anything under) -----
     LockDecl("obs.metrics.counter", "tpudl.obs.metrics", "lock",
              "instance", 30, "one Counter's running value"),

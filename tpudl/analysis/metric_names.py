@@ -307,6 +307,28 @@ METRICS: tuple[Metric, ...] = (
            "warm-start win: deserialization, not a 60s jit)"),
     Metric("serve.models", "gauge",
            "models registered in the serve registry"),
+    # -- serve SLO plane (ISSUE 18: tpudl.obs.slo windows) -------------
+    Metric("serve.slo.target_ms", "gauge",
+           "the configured latency objective "
+           "(TPUDL_SERVE_SLO_P99_MS) the windowed gauges judge "
+           "against"),
+    Metric("serve.slo.window_p50_ms", "gauge",
+           "p50 latency over the short SLO window "
+           "(TPUDL_SERVE_SLO_WINDOW_S) — recent, not lifetime"),
+    Metric("serve.slo.window_p99_ms", "gauge",
+           "p99 latency over the short SLO window — the number an "
+           "operator pages on"),
+    Metric("serve.slo.availability", "gauge",
+           "fraction of short-window requests meeting the objective"),
+    Metric("serve.slo.burn_short", "gauge",
+           "error-budget burn rate over the short window (violating "
+           "fraction / the 1% p99 budget; >= 1 = burning)"),
+    Metric("serve.slo.burn_long", "gauge",
+           "burn rate over the long (10x) window — page when BOTH "
+           "burn, investigate when only the short one does"),
+    Metric("serve.slo.exemplars", "counter",
+           "tail exemplars captured into the error ring (latency > "
+           "TPUDL_SERVE_SLO_TAIL_K x the windowed median)"),
 )
 
 METRIC_NAMES = frozenset(m.name for m in METRICS if "*" not in m.name)

@@ -40,6 +40,15 @@ gang), merge per-host evidence and CLASSIFY the failure:
   Ordered after ``degraded_run`` (the ladder explains WHY capacity
   shrank when both fired) and before the stall rules: a saturated
   serve loop still beating its heartbeat is shedding, not stuck;
+- ``slo_burn`` — the serve plane was admitting fine but missing its
+  latency objective: the windowed burn gauge (``serve.slo.burn_short``,
+  tpudl.obs.slo) was >= 1 at death and the error ring holds tail
+  exemplars whose segment breakdowns
+  (queue_wait/batching/prefill/decode, tpudl.serve.reqtrace) name
+  WHERE the time went. Ordered after ``overload_shed`` — shedding
+  outranks slow (typed rejects are the louder, more actionable fact)
+  — and before the stall rules: a burning-but-live serve loop still
+  beats its heartbeat (slow, not stuck);
 - ``dispatch_slowdown`` — a stall (or dominant stage share) in
   ``dispatch``: the device/backend stopped answering or slowed;
 - ``clean_external_kill`` — a SIGTERM/SIGQUIT dump with no stall and
@@ -80,6 +89,26 @@ STORM_MIN_FRAC = 0.10
 # brief historical blip never reroutes an unrelated death
 SHED_MIN_EVENTS = 8
 SHED_MIN_FRAC = 0.10
+
+# slo_burn gates: the burn gauge must show the budget actually burning
+# at death AND enough tail exemplars must exist to make the dominant-
+# segment attribution statistics, not an anecdote
+SLO_BURN_MIN = 1.0
+SLO_MIN_EXEMPLARS = 3
+# the reqtrace segment model, in lifecycle order, with the remedy each
+# dominant segment points at
+SLO_SEGMENTS = ("queue_wait", "batching", "prefill", "decode")
+SLO_REMEDIES = {
+    "queue_wait": "requests park at admission — raise "
+                  "TPUDL_SERVE_SLOTS or add serving capacity",
+    "batching": "rung packing is the cost — check the prompt-bucket "
+                "ladder (TPUDL_BUCKET_LADDER)",
+    "prefill": "first-token work dominates — warm the AOT program "
+               "store (TPUDL_COMPILE_AOT) so prefill rungs restore, "
+               "not compile",
+    "decode": "decode steps dominate — lower max_new, raise "
+              "TPUDL_SERVE_SLOTS, or add device capacity",
+}
 
 
 def load_dump(path: str) -> dict:
@@ -450,6 +479,57 @@ def classify(merged: dict) -> dict:
                 "suspect_host": shed_host or suspect_host,
                 "evidence": evidence, "stage_rates": rates}
 
+    # 2e. slo burn: admission was fine but the latency objective was
+    #     NOT being met at death — the windowed burn gauge says the
+    #     budget was burning and the tail exemplars in the error ring
+    #     say where the time went. After overload_shed (shedding
+    #     outranks slow) and before the stall rules (a slow-but-live
+    #     loop still beats its heartbeat).
+    exemplars = [e for e in errors
+                 if str(e.get("kind", "")).startswith("serve.slo")]
+    burn = max((_metric_value(d, "serve.slo.burn_short")
+                for d in hosts.values()), default=0.0)
+    if len(exemplars) >= SLO_MIN_EXEMPLARS and burn >= SLO_BURN_MIN:
+        target = max((_metric_value(d, "serve.slo.target_ms")
+                      for d in hosts.values()), default=0.0)
+        win_p99 = max((_metric_value(d, "serve.slo.window_p99_ms")
+                       for d in hosts.values()), default=0.0)
+        seg_tot: dict[str, float] = {}
+        for e in exemplars:
+            for seg in SLO_SEGMENTS:
+                v = e.get(f"{seg}_ms")
+                if isinstance(v, (int, float)):
+                    seg_tot[seg] = seg_tot.get(seg, 0.0) + float(v)
+        total_ms = sum(seg_tot.values())
+        dominant = (max(seg_tot.items(), key=lambda kv: kv[1])[0]
+                    if seg_tot else None)
+        headline = (f"p99 burn: windowed p99 {win_p99:.0f}ms against "
+                    f"the {target:.0f}ms objective "
+                    f"(burn {burn:.1f}x the error budget)")
+        if dominant is not None:
+            share = seg_tot[dominant] / max(total_ms, 1e-9)
+            headline += (f"; {share:.0%} of tail latency across "
+                         f"{len(exemplars)} exemplar(s) is {dominant}")
+        evidence.insert(0, headline)
+        if seg_tot:
+            evidence.append("tail time by segment: " + "  ".join(
+                f"{k} {v:.0f}ms" for k, v in sorted(
+                    seg_tot.items(), key=lambda kv: -kv[1])))
+        if dominant is not None:
+            evidence.append(SLO_REMEDIES.get(
+                dominant, "add serving capacity (SERVE.md)"))
+        if stalls:
+            last = stalls[-1]
+            evidence.append(
+                f"history: watchdog flagged {len(stalls)} stall(s); "
+                f"last: {last.get('name')} frozen {last.get('age_s')}s "
+                f"in stage {_stall_stage(last) or 'unknown'!r}")
+        return {"classification": "slo_burn",
+                "suspect_stage": dominant,
+                "suspect_host": (exemplars[-1].get("host")
+                                 or suspect_host),
+                "evidence": evidence, "stage_rates": rates}
+
     # 3/4. watchdog stalls: which side froze?
     if stalls:
         last = stalls[-1]
@@ -574,8 +654,9 @@ def format_report(merged: dict, diagnosis: dict,
         lines.append(f"error ring tail ({min(5, len(errors))} of "
                      f"{len(errors)}):")
         for e in errors[-5:]:
+            etype = f"{e['type']} " if e.get("type") else ""
             lines.append(f"  [host {e.get('host', 0)}] "
-                         f"{e.get('kind')}: {e.get('type')} "
+                         f"{e.get('kind')}: {etype}"
                          f"{str(e.get('message'))[:100]}")
     return "\n".join(lines)
 

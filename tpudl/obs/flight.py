@@ -56,11 +56,11 @@ from collections import deque
 from tpudl.testing import tsan as _tsan
 
 __all__ = ["FlightRecorder", "get_recorder", "record_error",
-           "record_batch", "dump", "install", "DUMP_SCHEMA",
-           "DUMP_VERSION", "dump_path_for"]
+           "record_batch", "record_request", "dump", "install",
+           "DUMP_SCHEMA", "DUMP_VERSION", "dump_path_for"]
 
 DUMP_SCHEMA = "tpudl-flight-dump"
-DUMP_VERSION = 1
+DUMP_VERSION = 2
 
 _DUMP_SEQ = itertools.count()  # tmp-name uniqueness across dump writers
 
@@ -69,6 +69,7 @@ _DEFAULT_BATCHES = 32
 _DEFAULT_ERRORS = 64
 _DEFAULT_STALLS = 16
 _DEFAULT_TICKS = 32
+_DEFAULT_REQUESTS = 64
 _DEFAULT_SPAN_TAIL = 512
 # env prefixes worth keeping in a dump — a full os.environ copy could
 # leak credentials into an artifact that gets attached to bug reports
@@ -170,6 +171,9 @@ class FlightRecorder:
             maxlen=max(1, _env_int("TPUDL_FLIGHT_STALLS", _DEFAULT_STALLS)))
         self._ticks: deque = deque(
             maxlen=max(1, _env_int("TPUDL_FLIGHT_TICKS", _DEFAULT_TICKS)))
+        self._requests: deque = deque(
+            maxlen=max(1, _env_int("TPUDL_FLIGHT_REQUESTS",
+                                   _DEFAULT_REQUESTS)))
         self._restarts: list = []  # train gang restarts: small + precious,
         self._events: deque = deque(maxlen=64)  # lifecycle breadcrumbs
         self._installed = False    # never ring-evicted
@@ -238,6 +242,15 @@ class FlightRecorder:
         self.record_error("train.restart", error, attempt=attempt,
                           step=step)
 
+    def record_request(self, rec: dict):
+        """One TERMINAL serve request's descriptor (trace id, segment
+        milliseconds, outcome — built by
+        :func:`tpudl.serve.reqtrace.request_record`; NEVER prompt
+        content, per the validate_dump contract). Serve hot path: must
+        stay a lock + deque append."""
+        with self._lock:
+            self._requests.append(rec)
+
     def record_stall(self, stall: dict):
         """Filed by the watchdog: one no-progress event with thread
         stacks at detection time."""
@@ -304,6 +317,7 @@ class FlightRecorder:
             payload["errors"] = list(self._errors)
             payload["stalls"] = list(self._stalls)
             payload["metric_ticks"] = list(self._ticks)
+            payload["requests"] = list(self._requests)
             payload["restarts"] = list(self._restarts)
             payload["events"] = list(self._events)
         # the rest of obs contributes its own rings (each best-effort:
@@ -487,7 +501,7 @@ class FlightRecorder:
         stays)."""
         with self._lock:
             for ring in (self._batches, self._errors, self._stalls,
-                         self._ticks, self._events):
+                         self._ticks, self._requests, self._events):
                 ring.clear()
             del self._restarts[:]
             del self.dumped_paths[:]
@@ -526,6 +540,10 @@ def record_error(kind: str, error, **ctx):
 
 def record_batch(stage: str, index: int, arrays, **info):
     _RECORDER.record_batch(stage, index, arrays, **info)
+
+
+def record_request(rec: dict):
+    _RECORDER.record_request(rec)
 
 
 def dump(reason: str = "manual", error=None, path: str | None = None,

@@ -268,6 +268,17 @@ def _serve_section(metrics: dict) -> dict | None:
 
     if val("serve.requests") is None and val("serve.queue_cap") is None:
         return None
+    # the WINDOWED view (ISSUE 18): recent p50/p99/burn from the SLO
+    # engine, so `obs top` answers "how are we doing NOW", not "since
+    # boot"; the lifetime percentiles stay as the fallback
+    try:
+        from tpudl.obs import slo as _slo
+
+        slo_section = _slo.get_slo_engine().status_section()
+    # tpudl: ignore[swallowed-except] — status writer daemon: a broken
+    # SLO engine must cost the slo block, never the whole status file
+    except Exception:
+        slo_section = None
     return {
         "requests": int(val("serve.requests") or 0),
         "rejects": int(val("serve.rejects") or 0),
@@ -284,6 +295,7 @@ def _serve_section(metrics: dict) -> dict | None:
         "p50_ms": pct("serve.latency_ms", "p50"),
         "p99_ms": pct("serve.latency_ms", "p99"),
         "models": int(val("serve.models") or 0),
+        "slo": slo_section,
     }
 
 
@@ -457,6 +469,39 @@ def _fmt_age(s: float) -> str:
     return f"{s / 60:.1f}m"
 
 
+def _fleet_serve_line(serves: list[dict]) -> str:
+    """One merged aggregate over every process's serve section (the
+    doctor's per-host-merge treatment applied to ``obs top``): summed
+    load, worst queue depth, and a REAL merged windowed p99 — computed
+    over the concatenation of each process's exported window sample
+    tail, not a max-of-p99s (which would overstate a balanced fleet)."""
+    from tpudl.obs.metrics import percentile as _pct
+
+    requests = sum(int(s.get("requests") or 0) for s in serves)
+    completed = sum(int(s.get("completed") or 0) for s in serves)
+    rejects = sum(int(s.get("rejects") or 0) for s in serves)
+    depth = max(int(s.get("queue_depth") or 0) for s in serves)
+    slos = [s.get("slo") or {} for s in serves]
+    qps = sum(float(sl.get("window_qps") or 0.0) for sl in slos)
+    samples: list = []
+    for sl in slos:
+        samples.extend(x for x in (sl.get("window_samples_ms") or [])
+                       if isinstance(x, (int, float)))
+    line = (f"fleet serve ({len(serves)} procs): req {requests}"
+            f"  done {completed}  rejects {rejects}"
+            f"  queue max {depth}")
+    if qps:
+        line += f"  qps {qps:.1f}"
+    merged_p99 = _pct(sorted(samples), 0.99)
+    if merged_p99 is not None:
+        line += f"  w_p99 {merged_p99:.0f}ms"
+    burns = [sl.get("burn_short") for sl in slos
+             if isinstance(sl.get("burn_short"), (int, float))]
+    if burns:
+        line += f"  burn {max(burns):.1f}x"
+    return line
+
+
 def render(statuses: list[dict], now: float | None = None) -> str:
     """One text frame over parsed status payloads — pure (testable)."""
     now = now if now is not None else time.time()
@@ -464,6 +509,9 @@ def render(statuses: list[dict], now: float | None = None) -> str:
              f"{time.strftime('%H:%M:%S', time.localtime(now))}"]
     if not statuses:
         lines.append("  (no tpudl-status-*.json files yet)")
+    serves = [st.get("serve") for st in statuses if st.get("serve")]
+    if len(serves) >= 2:
+        lines.append(_fleet_serve_line(serves))
     for st in statuses:
         age = now - (st.get("ts") or now)
         stale_after = 3 * float(st.get("interval_s") or 1.0) + 2.0
@@ -569,7 +617,15 @@ def render(statuses: list[dict], now: float | None = None) -> str:
                 line += f"  occ {100 * srv['occupancy']:.0f}%"
             if srv.get("tokens_per_s") is not None:
                 line += f"  tok/s {srv['tokens_per_s']:.1f}"
-            if srv.get("p99_ms") is not None:
+            slo = srv.get("slo") or {}
+            if slo.get("window_p99_ms") is not None:
+                # the WINDOWED truth (last window_s seconds), not the
+                # lifetime histogram — "now", the number you page on
+                line += (f"  w_p50 {slo['window_p50_ms']:.0f}ms"
+                         f"  w_p99 {slo['window_p99_ms']:.0f}ms")
+                if slo.get("burn_short") is not None:
+                    line += f"  burn {slo['burn_short']:.1f}x"
+            elif srv.get("p99_ms") is not None:
                 line += f"  p99 {srv['p99_ms']:.0f}ms"
             if srv.get("models", 0) > 1:
                 line += f"  models {srv['models']}"

@@ -29,11 +29,22 @@ from tpudl.testing import tsan as _tsan
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "counter", "gauge", "histogram", "snapshot",
-           "flush_metrics", "Meter", "timed"]
+           "flush_metrics", "Meter", "timed", "percentile"]
 
 # per-histogram/gauge retained samples; running aggregates keep
 # mean/max exact over ALL samples no matter the cap
 DEFAULT_SAMPLE_CAP = 4096
+
+
+def percentile(sorted_xs, q: float):
+    """Nearest-rank percentile of an ASCENDING-sorted sequence
+    (``None`` when empty) — THE percentile for every obs/serve
+    consumer: histograms, the serve load generator, the SLO window.
+    One definition so a bench p99 and an obs p99 can never disagree by
+    implementation."""
+    if not sorted_xs:
+        return None
+    return sorted_xs[min(len(sorted_xs) - 1, int(q * len(sorted_xs)))]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -128,10 +139,7 @@ class Histogram:
             self.observe(time.perf_counter() - t0)
 
     def _percentile(self, sorted_ring: list, q: float):
-        if not sorted_ring:
-            return None
-        i = min(len(sorted_ring) - 1, int(q * len(sorted_ring)))
-        return sorted_ring[i]
+        return percentile(sorted_ring, q)
 
     def to_dict(self) -> dict:
         with self._lock:
@@ -140,9 +148,9 @@ class Histogram:
                 "type": "histogram", "count": self.count,
                 "sum": self.total, "min": self.min, "max": self.max,
                 "mean": (self.total / self.count) if self.count else None,
-                "p50": self._percentile(ring, 0.50),
-                "p95": self._percentile(ring, 0.95),
-                "p99": self._percentile(ring, 0.99),
+                "p50": percentile(ring, 0.50),
+                "p95": percentile(ring, 0.95),
+                "p99": percentile(ring, 0.99),
             }
 
 

@@ -9,10 +9,17 @@ multi-model registry that warm-starts every model's programs from the
 persisted store so time-to-first-token is a deserialization, not a
 60-second jit. Overload rides the PR-14 degradation ladder; SLO
 metrics (``serve.*``) publish through ``tpudl.obs``.
+
+Request-scoped telemetry (ISSUE 18): every request carries a
+:class:`~tpudl.serve.reqtrace.ReqTrace` of lifecycle stamps that
+decompose its latency into queue_wait/batching/prefill/decode
+segments; completed requests feed the windowed SLO engine
+(:mod:`tpudl.obs.slo`) and the flight recorder's request ring.
 """
 
 from tpudl.serve.queue import (AdmissionError, DeadlineExceeded,
                                Evicted, RequestQueue, ServeRequest)
+from tpudl.serve.reqtrace import ReqTrace
 from tpudl.serve.batching import RungBatcher
 from tpudl.serve.slots import SlotDecoder
 from tpudl.serve.registry import ModelRegistry
@@ -20,5 +27,5 @@ from tpudl.serve.server import Server
 from tpudl.serve.loadgen import run_closed_loop
 
 __all__ = ["AdmissionError", "DeadlineExceeded", "Evicted",
-           "RequestQueue", "ServeRequest", "RungBatcher", "SlotDecoder",
-           "ModelRegistry", "Server", "run_closed_loop"]
+           "RequestQueue", "ServeRequest", "ReqTrace", "RungBatcher",
+           "SlotDecoder", "ModelRegistry", "Server", "run_closed_loop"]

@@ -30,6 +30,7 @@ import time
 
 import numpy as np
 
+from tpudl.obs.metrics import percentile
 from tpudl.serve.queue import AdmissionError
 from tpudl.testing import faults as _faults
 from tpudl.testing import tsan as _tsan
@@ -38,10 +39,10 @@ __all__ = ["run_closed_loop"]
 
 
 def _percentile(xs: list, q: float):
-    if not xs:
-        return None
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(q * len(xs)))]
+    # the one shared nearest-rank implementation (tpudl.obs.metrics):
+    # the loadgen's ground truth and the obs plane's windows can never
+    # disagree by construction
+    return percentile(sorted(xs), q)
 
 
 def run_closed_loop(server, make_prompt, *, requests: int,

@@ -30,6 +30,7 @@ from collections import deque
 import numpy as np
 
 from tpudl.obs import metrics as _metrics
+from tpudl.serve import reqtrace as _reqtrace
 from tpudl.testing import tsan as _tsan
 
 __all__ = ["AdmissionError", "DeadlineExceeded", "Evicted",
@@ -84,7 +85,7 @@ class ServeRequest:
 
     __slots__ = ("prompt", "max_new", "model", "rng", "submitted",
                  "deadline", "tokens", "error", "ttft_s", "latency_s",
-                 "done")
+                 "done", "trace")
 
     def __init__(self, prompt, max_new: int, *, model: str = "default",
                  deadline_s: float | None = None, rng=None):
@@ -108,6 +109,12 @@ class ServeRequest:
         self.ttft_s: float | None = None
         self.latency_s: float | None = None
         self.done = threading.Event()
+        # lifecycle trace (None when TPUDL_SERVE_TRACE=0); stamps are
+        # lock-free appends on whichever thread owns the request at
+        # that phase (reqtrace.py)
+        self.trace = _reqtrace.new_trace()
+        if self.trace is not None:
+            self.trace.stamp("submit")
 
     @property
     def nbytes(self) -> int:
@@ -122,11 +129,15 @@ class ServeRequest:
     def finish(self, tokens) -> None:
         self.tokens = np.asarray(tokens, dtype=np.int32)
         self.latency_s = time.monotonic() - self.submitted
+        if self.trace is not None:
+            self.trace.stamp("complete", force=True)
         self.done.set()
 
     def fail(self, error: BaseException) -> None:
         self.error = error
         self.latency_s = time.monotonic() - self.submitted
+        if self.trace is not None:
+            self.trace.stamp("fail", force=True)
         self.done.set()
 
     def result(self, timeout: float | None = None) -> np.ndarray:
@@ -197,11 +208,15 @@ class RequestQueue:
                 self._items.append(req)
                 self._bytes += req.nbytes
                 depth = len(self._items)
-        # metrics OUTSIDE the lock (locks.py: publication never nests
-        # under a serve lock)
+        # metrics/stamps OUTSIDE the lock (locks.py: publication never
+        # nests under a serve lock)
         if reject is not None:
+            if req.trace is not None:
+                req.trace.stamp(f"reject:{reject.reason}")
             _metrics.counter("serve.rejects").inc()
             raise reject
+        if req.trace is not None:
+            req.trace.stamp("admit")
         _metrics.counter("serve.requests").inc()
         _metrics.gauge("serve.queue_depth").set(depth)
         return req
@@ -230,6 +245,9 @@ class RequestQueue:
                     kept.append(req)
             self._items = kept
             depth = len(self._items)
+        for req in taken:
+            if req.trace is not None:
+                req.trace.stamp("queue_wait_end")
         for req in shed:
             req.fail(DeadlineExceeded(
                 f"deadline passed {now - req.deadline:.3f}s before "

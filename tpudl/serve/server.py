@@ -30,9 +30,12 @@ from __future__ import annotations
 import threading
 import time
 
+from tpudl.obs import flight as _flight
 from tpudl.obs import metrics as _metrics
 from tpudl.obs import pipeline as _pipeline
+from tpudl.obs import slo as _slo
 from tpudl.obs import watchdog as _watchdog
+from tpudl.serve import reqtrace as _reqtrace
 from tpudl.serve.queue import DeadlineExceeded, RequestQueue, \
     ServeRequest
 from tpudl.testing import faults as _faults
@@ -187,6 +190,9 @@ class Server:
                     time.sleep(0.0005)  # idle poll, clients may appear
         wall = time.perf_counter() - t0
         report.finish(wall)
+        # final gauge refresh so a post-run snapshot/status read shows
+        # the session's closing window, not a stale throttled view
+        _slo.get_slo_engine().publish(force=True)
         return {"ticks": tick, "completed": completed,
                 "admitted": admitted, "wall_s": round(wall, 4),
                 "models": len(entries),
@@ -233,12 +239,18 @@ class Server:
 
     def _harvest(self, entries, report) -> int:
         done = 0
+        slo = _slo.get_slo_engine()
         for entry in entries:
             for req, toks in entry.engine.pop_completed():
                 req.finish(toks)
                 _metrics.histogram("serve.latency_ms").observe(
                     req.latency_s * 1000.0)
                 _metrics.counter("serve.completed").inc()
+                # windowed SLO stamp + tail-exemplar check, then the
+                # flight recorder's request ring (descriptor only)
+                slo.record(req)
+                _flight.get_recorder().record_request(
+                    _reqtrace.request_record(req))
                 report.progress(1)
                 done += 1
         return done

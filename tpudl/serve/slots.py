@@ -30,6 +30,7 @@ import os
 import numpy as np
 
 from tpudl.obs import metrics as _metrics
+from tpudl.serve import reqtrace as _reqtrace
 from tpudl.serve.queue import AdmissionError, Evicted
 
 __all__ = ["SlotDecoder"]
@@ -79,8 +80,12 @@ class SlotDecoder:
         self._steps = np.zeros(self.slots, dtype=np.int32)
         key0 = np.asarray(jax.random.PRNGKey(0))
         self._keys = np.stack([key0] * self.slots)
-        # per-slot occupant: {"request", "tokens": [ints]} or None
+        # per-slot occupant: {"request", "tokens": [ints], "trace"}
+        # or None
         self._meta: list[dict | None] = [None] * self.slots
+        # decode-cadence stamp stride, resolved once (the step loop is
+        # the hot path — no env read per token)
+        self._trace_cadence = _reqtrace.decode_cadence()
 
     # -- host-side bookkeeping --------------------------------------------
     def free(self) -> list:
@@ -145,10 +150,15 @@ class SlotDecoder:
                 f"TPUDL_SERVE_SLOTS or queue the request",
                 reason="slots_full")
         slot = free[0]
+        trace = getattr(request, "trace", None)
+        if trace is not None:
+            trace.stamp("slot_insert")
         plen = int(request.prompt.shape[1])
         rung = self.rung_for(plen, request.max_new)
         padded = np.zeros((1, rung), dtype=np.int32)
         padded[:, :plen] = request.prompt
+        if trace is not None:
+            trace.stamp("rung_pack")
         key = self._normalize_key(request.rng)
         fill = self.model._slot_prefill_program(
             rung, self.slots, self.cache_len, self.temperature,
@@ -162,11 +172,14 @@ class SlotDecoder:
             jnp.asarray(key), jnp.asarray(plen, jnp.int32),
             jnp.asarray(slot, jnp.int32)))
         first_tok = int(np.asarray(first)[0])
+        if trace is not None:
+            trace.stamp("first_token")
         self._tok[slot] = first_tok
         self._pos[slot] = plen
         self._steps[slot] = 1
         self._keys[slot] = key
-        self._meta[slot] = {"request": request, "tokens": [first_tok]}
+        self._meta[slot] = {"request": request, "tokens": [first_tok],
+                            "trace": trace}
         _metrics.counter("serve.inserts").inc()
         return slot
 
@@ -189,8 +202,15 @@ class SlotDecoder:
             jnp.asarray(self._pos), jnp.asarray(self._keys),
             jnp.asarray(self._steps)))
         nxt = np.asarray(nxt).copy()  # device views are read-only
+        cad = self._trace_cadence
         for s in active:
-            self._meta[s]["tokens"].append(int(nxt[s]))
+            meta = self._meta[s]
+            meta["tokens"].append(int(nxt[s]))
+            trace = meta["trace"]
+            if trace is not None:
+                n = len(meta["tokens"])
+                if n % cad == 0:
+                    trace.stamp(f"decode_{n}")
         self._tok = nxt.astype(np.int32)
         self._pos[active] += 1
         self._steps[active] += 1
@@ -211,6 +231,9 @@ class SlotDecoder:
         self._meta[int(slot)] = None
         _metrics.counter("serve.evictions").inc()
         req = meta["request"]
+        trace = meta.get("trace")
+        if trace is not None:
+            trace.stamp("evict")
         if error is not None:
             req.fail(error)
         return req
