@@ -381,6 +381,23 @@ def _compact_summary(record: dict) -> dict:
             # saturation under load — plus the ISSUE-18 windowed pair
             # (SLO-engine recent p99 + burn) beside the lifetime p99
             s[k] = _scalar(sv[k])
+    lt = record.get("lm_train") or {}
+    for k in ("lm_train_tokens_per_sec", "lm_warm_epoch_speedup",
+              "lm_epoch2_tokenize_calls", "lm_epoch2_wire_bytes"):
+        if lt.get(k) is not None:
+            # the ISSUE-19 tokens/s one-liners: warm-epoch fine-tune
+            # throughput, its cold-epoch ratio, and the epoch-2
+            # zero-decode/zero-wire evidence (both deltas must be 0 —
+            # tokenized batches replay from HBM, never re-tokenized,
+            # never re-shipped)
+            s[k] = _scalar(lt[k])
+    lg = record.get("lm_generate") or {}
+    for k in ("lm_generate_tokens_per_sec", "lm_generate_programs"):
+        if lg.get(k) is not None:
+            # generated tokens/s over a ragged prompt column, plus how
+            # few bucketed programs served the whole mix (the O(log n)
+            # signature claim on the judged line)
+            s[k] = _scalar(lg[k])
     snap = record.get("metrics_snapshot") or {}
     for name, key in (("compile.hits", "compile_hits"),
                       ("compile.misses", "compile_misses")):
@@ -2283,6 +2300,268 @@ def measure_serve():
     return out
 
 
+def _lm_bench_loss(lm):
+    """Next-token loss for the lm_train child: the zoo's own
+    ``loss_fn`` when :mod:`tpudl.attention` imports, else a bench-local
+    forward through the SAME ``_decoder_block`` body with a dense
+    causal attention (identical math/FLOPs to attention_reference) —
+    the gated-dep fallback for jax builds without top-level
+    ``shard_map``, so the tokens/s family still measures on them.
+    Returns (loss, mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        import tpudl.attention  # noqa: F401
+
+        return lm.loss_fn(), "zoo"
+    except ImportError:
+        from tpudl.zoo.transformer import _layer_norm
+
+        def dense_attn(q, k, v):
+            scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+        def forward(params, tokens):
+            x = params["embed"]["table"][tokens]
+            for i in range(lm.layers):
+                x = lm._decoder_block(x, params[f"block_{i}"],
+                                      dense_attn)
+            x = _layer_norm(x, params["final_norm"])
+            return x @ params["embed"]["table"].T
+
+        def loss(params, tokens):
+            logits = forward(params, tokens[:, :-1])
+            targets = tokens[:, 1:]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                      axis=-1)
+            picked = jnp.take_along_axis(
+                logp, targets[..., None].astype(jnp.int32), axis=-1)
+            return -jnp.mean(picked)
+
+        return loss, "shim"
+
+
+def run_lm_train_child(out_path):
+    """Subprocess body of the lm_train sub-bench (``bench.py
+    --lm-train-child``): a 2-epoch tokenized fine-tune of the zoo LM
+    over a string column via ``tpudl.text.lm_dataset`` — tokenize +
+    dense-pack on the prepare pool, TokenCodec u16 ids on the wire,
+    HBM-tier batch residency. Epoch 1 is the cold arm (tokenize +
+    ship); epoch 2 is the judged warm arm and must replay RESIDENT
+    batches: the child records the epoch-2 ``text.tokenize.calls`` and
+    ``data.wire.bytes_shipped`` deltas, which the tier-1 warm-replay
+    test (tests/test_text.py) pins to exactly zero."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # never the tunneled TPU
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl import obs
+    from tpudl.frame import Frame
+    from tpudl.text import ByteTokenizer, lm_dataset
+    from tpudl.zoo.transformer import TinyCausalLM
+
+    rows = int(os.environ.get("TPUDL_BENCH_LM_ROWS", "192"))
+    seq = int(os.environ.get("TPUDL_BENCH_LM_SEQ", "64"))
+    batch = int(os.environ.get("TPUDL_BENCH_LM_BATCH", "32"))
+    rows -= rows % batch or batch  # full frame batches: stable shapes
+    # uniform (seq-1)-byte docs: each +eos packs to exactly seq tokens,
+    # so every prepared batch is [batch, seq] — ONE compiled train step
+    base = "the quick brown fox jumps over the lazy dog again and "
+    texts = [(f"{i:06d} " + base)[: seq - 1] for i in range(max(rows, 1))]
+    frame = Frame({"text": np.array(texts, dtype=object)})
+    tok = ByteTokenizer()
+    lm = TinyCausalLM(vocab=tok.vocab_size, dim=64, heads=4, layers=2,
+                      max_len=seq)
+    params = jax.tree.map(jnp.asarray, lm.init(0))
+    ds = lm_dataset(frame, "text", tok, seq_len=seq, batch_size=batch,
+                    device_cache=True)
+    loss, mode = _lm_bench_loss(lm)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, o, wire):
+        tokens = wire.astype(jnp.int32)  # the TokenCodec prologue
+        l, g = jax.value_and_grad(loss)(p, tokens)
+        updates, o = opt.update(g, o)
+        return optax.apply_updates(p, updates), o, l
+
+    def counters():
+        snap = obs.snapshot()
+        return {k: int((snap.get(k) or {}).get("value") or 0)
+                for k in ("text.tokenize.calls",
+                          "data.wire.bytes_shipped")}
+
+    epochs = []
+    losses = []
+    for epoch in range(2):
+        c0 = counters()
+        t0 = time.perf_counter()
+        n_tok = 0
+        for (wire,) in ds.iter_epoch(epoch):
+            params, opt_state, l = step(params, opt_state, wire)
+            n_tok += int(np.prod(np.shape(wire)))
+        jax.block_until_ready(l)
+        dt = time.perf_counter() - t0
+        c1 = counters()
+        losses.append(float(l))
+        epochs.append({
+            "tokens": n_tok, "seconds": round(dt, 4),
+            "tokens_per_sec": round(n_tok / dt, 1) if dt > 0 else None,
+            "tokenize_calls": c1["text.tokenize.calls"]
+            - c0["text.tokenize.calls"],
+            "wire_bytes": c1["data.wire.bytes_shipped"]
+            - c0["data.wire.bytes_shipped"]})
+    cold, warm = epochs
+    out = {"tokens_per_sec": warm["tokens_per_sec"],
+           "cold_tokens_per_sec": cold["tokens_per_sec"],
+           "epoch2_tokenize_calls": warm["tokenize_calls"],
+           "epoch2_wire_bytes": warm["wire_bytes"],
+           "warm_epoch_speedup": (
+               round(cold["seconds"] / warm["seconds"], 2)
+               if warm["seconds"] > 0 else None),
+           "loss_first": round(losses[0], 4),
+           "loss_last": round(losses[-1], 4),
+           "forward": mode, "rows": len(texts), "seq_len": seq,
+           "batch_rows": batch}
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+
+
+def run_lm_generate_child(out_path):
+    """Subprocess body of the lm_generate sub-bench (``bench.py
+    --lm-generate-child``): an LMGenerator transform over a RAGGED
+    prompt column (6 distinct byte lengths → a handful of pow2 rungs).
+    A one-prompt-per-rung warmup compiles the bucketed programs first,
+    so ``tokens_per_sec`` is the steady state the zero-retrace sweep
+    proves; ``first_transform_s`` keeps the compile cost on the
+    record."""
+    t0 = time.perf_counter()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # never the tunneled TPU
+    from tpudl import obs
+    from tpudl.frame import Frame
+    from tpudl.ml import LMGenerator
+    from tpudl.text import ByteTokenizer
+    from tpudl.zoo.transformer import TinyCausalLM
+
+    n = int(os.environ.get("TPUDL_BENCH_LM_PROMPTS", "48"))
+    max_new = int(os.environ.get("TPUDL_BENCH_LM_MAX_NEW", "8"))
+    tok = ByteTokenizer()
+    lm = TinyCausalLM(vocab=tok.vocab_size, dim=32, heads=4, layers=2,
+                      max_len=64)
+    params = lm.init(0)
+    plens = (3, 5, 8, 12, 17, 24)  # the serve child's ragged mix
+    base = "abcdefghijklmnopqrstuvwxyz"
+    prompts = [base[: plens[i % len(plens)]] for i in range(n)]
+    gen = LMGenerator(inputCol="text", outputCol="gen", model=lm,
+                      weights=params, tokenizer=tok, maxNew=max_new,
+                      batchSize=8, promptBuckets="pow2")
+    # warmup: one prompt per distinct length compiles every (batch
+    # rung=1, prompt rung) program this mix can dispatch
+    warm_frame = Frame({"text": np.array(
+        [base[: p] for p in plens], dtype=object)})
+    gen.transform(warm_frame)
+    first_transform_s = time.perf_counter() - t0
+
+    def gen_tokens():
+        snap = obs.snapshot()
+        return int((snap.get("lm.generate.tokens") or {}).get("value")
+                   or 0)
+
+    g0 = gen_tokens()
+    t1 = time.perf_counter()
+    frame = Frame({"text": np.array(prompts, dtype=object)})
+    gen.transform(frame)
+    dt = time.perf_counter() - t1
+    n_new = gen_tokens() - g0
+    with open(out_path, "w") as f:
+        json.dump({"tokens_per_sec": (round(n_new / dt, 1)
+                                      if dt > 0 else None),
+                   "generated_tokens": n_new,
+                   "requests": n,
+                   "max_new": max_new,
+                   "first_transform_s": round(first_transform_s, 4),
+                   "gen_programs": len(lm._gen_jits)}, f)
+
+
+def _run_lm_child(flag, prefix):
+    """Run one lm child subprocess and return its JSON record (the
+    serve-child plumbing: platform pinned in-process by the child —
+    JAX_PLATFORMS=cpu in env hangs the axon image)."""
+    import subprocess
+
+    me = os.path.abspath(__file__)
+    timeout = float(os.environ.get("TPUDL_BENCH_TRIAL_TIMEOUT_S", "450"))
+    with tempfile.TemporaryDirectory(prefix=prefix) as td:
+        out_path = os.path.join(td, "lm.json")
+        r = subprocess.run([sys.executable, me, flag, out_path],
+                           capture_output=True, text=True,
+                           env=dict(os.environ), timeout=timeout)
+        if r.returncode != 0 or not os.path.exists(out_path):
+            raise RuntimeError(
+                f"{flag} child rc={r.returncode}: {r.stderr[-400:]}")
+        with open(out_path) as f:
+            return json.load(f)
+
+
+def measure_lm_train():
+    """lm_train sub-bench (ROADMAP item 4, TEXT.md): tokens/s of a
+    tokenized 2-epoch LM fine-tune through the full text pipeline —
+    tokenize+pack on the prepare pool, TokenCodec wire, HBM-resident
+    epoch 2. The judged scalar is the WARM epoch's tokens/s; the
+    epoch-2 tokenize-call and wire-byte deltas ride the record as the
+    zero-decode/zero-wire evidence (both must read 0)."""
+    trials = [_run_lm_child("--lm-train-child", "tpudl-lm-train-")
+              for _ in range(2)]
+    out = dict(trials[-1])
+    rates = [t["tokens_per_sec"] for t in trials
+             if t.get("tokens_per_sec")]
+    if rates:
+        out["lm_train_tokens_per_sec"] = round(statistics.median(rates),
+                                               1)
+    out["lm_epoch2_tokenize_calls"] = int(
+        max(t.get("epoch2_tokenize_calls") or 0 for t in trials))
+    out["lm_epoch2_wire_bytes"] = int(
+        max(t.get("epoch2_wire_bytes") or 0 for t in trials))
+    out["lm_warm_epoch_speedup"] = out.get("warm_epoch_speedup")
+    log(f"lm_train: {out.get('lm_train_tokens_per_sec')} tokens/s warm "
+        f"(cold {out.get('cold_tokens_per_sec')}), epoch-2 deltas: "
+        f"{out['lm_epoch2_tokenize_calls']} tokenize calls, "
+        f"{out['lm_epoch2_wire_bytes']} wire bytes "
+        f"[forward={out.get('forward')}]")
+    return out
+
+
+def measure_lm_generate():
+    """lm_generate sub-bench: steady-state generated tokens/s of an
+    LMGenerator transform over a ragged prompt column, every dispatch
+    on warmed bucket-ladder programs (the O(log n) signature claim,
+    traceck-proven in tier-1)."""
+    trials = [_run_lm_child("--lm-generate-child", "tpudl-lm-gen-")
+              for _ in range(2)]
+    out = dict(trials[-1])
+    rates = [t["tokens_per_sec"] for t in trials
+             if t.get("tokens_per_sec")]
+    if rates:
+        out["lm_generate_tokens_per_sec"] = round(
+            statistics.median(rates), 1)
+    out["lm_generate_programs"] = int(out.get("gen_programs") or 0)
+    log(f"lm_generate: {out.get('lm_generate_tokens_per_sec')} tokens/s "
+        f"({out.get('generated_tokens')} tokens over "
+        f"{out.get('requests')} ragged prompts, "
+        f"{out['lm_generate_programs']} compiled programs)")
+    return out
+
+
 def run_preemption_job(workdir, out_path, steps, save_every,
                        progress_path):
     """Subprocess body of the preemption sub-bench (``bench.py
@@ -2872,7 +3151,8 @@ def main():
         # tunnel weather INSIDE the same record
         probed = {"horovod_resnet50", "predictor_resnet50",
                   "estimator_inception", "data_pipeline",
-                  "async_dispatch", "device_cache"}
+                  "async_dispatch", "device_cache", "lm_train",
+                  "lm_generate"}
         for key, fn in [("horovod_resnet50", lambda: measure_train_step(dtype)),
                         ("predictor_resnet50", lambda: measure_predictor(dtype)),
                         ("keras_transformer_mlp", measure_keras_transformer),
@@ -2887,6 +3167,8 @@ def main():
                         ("mesh_2d", measure_mesh_2d),
                         ("cold_start", measure_cold_start),
                         ("serve", measure_serve),
+                        ("lm_train", measure_lm_train),
+                        ("lm_generate", measure_lm_generate),
                         ("preemption", measure_preemption),
                         ("flash_attention", measure_flash_attention)]:
             if not _gate(extra, key):
@@ -2963,6 +3245,10 @@ if __name__ == "__main__":
         run_cold_start_child(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve-child":
         run_serve_child(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--lm-train-child":
+        run_lm_train_child(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--lm-generate-child":
+        run_lm_generate_child(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--preemption-job":
         wd, outp, n_steps, save_ev, progp = sys.argv[2:7]
         run_preemption_job(wd, outp, int(n_steps), int(save_ev), progp)

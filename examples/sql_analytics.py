@@ -50,6 +50,25 @@ def main():
     print("top-3 rows:", [(r["label"], round(float(r["y"]), 3))
                           for r in top.collect()])
 
+    # -- LM UDFs over a string column (TEXT.md): one registration call
+    # binds generate/embed to a model + tokenizer, then plain SQL
+    from tpudl.text import ByteTokenizer
+    from tpudl.udf import register_text_udfs
+    from tpudl.zoo.transformer import TinyCausalLM
+
+    tok = ByteTokenizer()
+    lm = TinyCausalLM(vocab=tok.vocab_size, dim=32, heads=4, layers=2,
+                      max_len=64)
+    register_text_udfs(model=lm, weights=lm.init(0), tokenizer=tok,
+                       max_new=8, batch_size=4)
+    docs = Frame({"label": np.array(["cat", "dog", "fox"], dtype=object),
+                  "prompt": np.array(["the cat sat", "dogs run",
+                                      "a fox"], dtype=object)})
+    stories = sql("SELECT label, generate(prompt) AS story FROM d",
+                  {"d": docs})
+    for row in stories.collect():
+        print(f"  {row['label']:>4}: {row['story']!r}")
+
 
 if __name__ == "__main__":
     main()

@@ -70,6 +70,14 @@ echo "== serve telemetry subset (ISSUE 18: traces + SLO acceptance) =="
 # must fail loudly on their own line.
 python -m pytest tests/test_serve_telemetry.py -q "$@"
 
+echo "== text subset (ISSUE 19: tokenizer codec + tokens/s acceptance) =="
+# Target the text module DIRECTLY (same rationale as the armed
+# concurrency subset above): the traceck-armed ragged prompt sweep
+# runs in a subprocess the test spawns itself, and the epoch-2
+# zero-tokenize/zero-wire warm-replay pin must fail loudly on its
+# own line.
+python -m pytest tests/test_text.py -q "$@"
+
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 

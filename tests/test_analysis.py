@@ -728,7 +728,7 @@ class TestRegistries:
                               "path", "json")
             assert k.subsystem in ("frame", "data", "obs", "jobs",
                                    "train", "zoo", "compile", "serve",
-                                   "bench")
+                                   "text", "bench")
             assert k.help
         assert len(KNOB_NAMES) == len(KNOBS)  # no duplicate names
 

@@ -240,6 +240,20 @@ METRICS = [
            keys=[("serve", "slo_window_p99_ms")],
            tail_patterns=[r'"slo_window_p99_ms": ' + _NUM],
            wire_sensitive=False, floor=0.30, lower_is_better=True),
+    # text plane (ISSUE 19): tokens/s through the tokenized pipeline.
+    # lm_train's judged arm is the WARM epoch — tokenize + wire paid
+    # in epoch 1, epoch 2 replays HBM-resident packed batches — so the
+    # rate is compute-shaped, not tunnel-shaped; scored raw
+    Metric("lm_train_tokens_per_sec",
+           keys=[("lm_train", "lm_train_tokens_per_sec")],
+           tail_patterns=[r'"lm_train_tokens_per_sec": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
+    # generated tokens/s over a ragged prompt column on warmed bucket-
+    # ladder programs: decode-loop-shaped, no per-token wire payload
+    Metric("lm_generate_tokens_per_sec",
+           keys=[("lm_generate", "lm_generate_tokens_per_sec")],
+           tail_patterns=[r'"lm_generate_tokens_per_sec": ' + _NUM],
+           wire_sensitive=False, floor=0.30),
 ]
 
 # every H2D figure a round can carry, in preference-free union (the

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Integrity audit for tpudl.text vocab manifests and packed batches.
+
+The seventh validator (the ``tools/validate_shards.py`` pattern, wired
+into tier-1 the same way — tests/test_text.py loads this module and
+drives it over real and deliberately-corrupted artifacts): given a
+vocab manifest written by ``Tokenizer.save`` it checks the document
+schema (format tag, mode, specials block, word-vocab uniqueness) and
+recomputes the fingerprint FROM SCRATCH — sha1 over the canonical spec
+JSON, the same math as ``tpudl.text.tokenizer.spec_fingerprint`` but
+deliberately re-implemented here so a drift in either side fails the
+audit instead of hiding in a shared helper. Optional ``.npy``
+arguments are audited as packed token batches against the manifest's
+vocab: integer dtype, 2-D, every id in ``[0, vocab_size)``, and
+right-padding contiguity (within a row, everything after the first
+pad must be pad — the invariant ``pad_mask`` and packed replay lean
+on). Exit 0 = manifest and every batch intact.
+
+Pure stdlib + numpy, importable (``from validate_text import
+validate_vocab, validate_packed``) and runnable
+(``python tools/validate_text.py <vocab.json> [packed.npy ...]``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+VOCAB_FORMAT = "tpudl-vocab-v1"
+SPECIALS = {"pad": 0, "bos": 1, "eos": 2, "unk": 3}
+N_SPECIALS = 4
+_MODES = ("byte", "word")
+
+
+def spec_fingerprint(spec: dict) -> str:
+    """The fingerprint definition, mirrored from
+    ``tpudl.text.tokenizer`` byte for byte: sha1 over sorted-key,
+    compact-separator, ascii-only JSON of the spec."""
+    blob = json.dumps(spec, sort_keys=True, separators=(",", ":"),
+                      ensure_ascii=True)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()
+
+
+def validate_vocab(path: str) -> tuple[list[str], int]:
+    """(errors, vocab_size) for one vocab manifest. vocab_size is 0
+    when the document is too broken to size."""
+    errs: list[str] = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable manifest ({e})"], 0
+    if not isinstance(doc, dict):
+        return [f"{path}: manifest is not a JSON object"], 0
+    if doc.get("format") != VOCAB_FORMAT:
+        errs.append(f"{path}: format {doc.get('format')!r} != "
+                    f"{VOCAB_FORMAT!r}")
+    spec = {k: v for k, v in doc.items()
+            if k not in ("format", "fingerprint")}
+    mode = spec.get("mode")
+    if mode not in _MODES:
+        errs.append(f"{path}: mode {mode!r} not in {list(_MODES)}")
+        return errs, 0
+    if not isinstance(spec.get("lowercase"), bool):
+        errs.append(f"{path}: lowercase missing or non-bool")
+    if spec.get("specials") != SPECIALS:
+        errs.append(f"{path}: specials {spec.get('specials')!r} != "
+                    f"{SPECIALS!r} (ids are pinned — pad MUST be 0)")
+    vocab_size = 0
+    if mode == "byte":
+        vocab_size = N_SPECIALS + 256
+        extra = set(spec) - {"mode", "lowercase", "specials"}
+        if extra:
+            errs.append(f"{path}: unexpected byte-spec keys "
+                        f"{sorted(extra)}")
+    else:
+        tokens = spec.get("tokens")
+        if (not isinstance(tokens, list)
+                or not all(isinstance(t, str) for t in tokens)):
+            errs.append(f"{path}: tokens missing or not a string list")
+        else:
+            if len(set(tokens)) != len(tokens):
+                errs.append(f"{path}: duplicate vocab tokens")
+            vocab_size = N_SPECIALS + len(tokens)
+    want = doc.get("fingerprint")
+    if not (isinstance(want, str) and len(want) == 40):
+        errs.append(f"{path}: fingerprint missing or not a 40-char "
+                    "sha1 hex string")
+    elif spec_fingerprint(spec) != want:
+        errs.append(f"{path}: fingerprint mismatch (manifest "
+                    f"{want[:12]}..., recomputed "
+                    f"{spec_fingerprint(spec)[:12]}...) — the vocab "
+                    "was edited after it was fingerprinted")
+    return errs, vocab_size
+
+
+def validate_packed(path: str, vocab_size: int,
+                    pad_id: int = SPECIALS["pad"]) -> list[str]:
+    """Audit one packed-batch ``.npy`` against a vocab size: dtype,
+    rank, id bounds, and right-pad contiguity."""
+    errs: list[str] = []
+    try:
+        arr = np.load(path, allow_pickle=False)
+    except Exception as e:
+        return [f"{path}: unreadable npy ({e})"]
+    if not np.issubdtype(arr.dtype, np.integer):
+        return [f"{path}: dtype {arr.dtype} is not integer (token ids "
+                "ride the wire as u16/i32)"]
+    if arr.ndim != 2:
+        return [f"{path}: rank {arr.ndim} != 2 (packed batches are "
+                "[rows, seq])"]
+    if arr.size == 0:
+        return [f"{path}: empty batch"]
+    lo, hi = int(arr.min()), int(arr.max())
+    if lo < 0:
+        errs.append(f"{path}: negative token id {lo}")
+    if vocab_size and hi >= vocab_size:
+        errs.append(f"{path}: token id {hi} >= vocab_size {vocab_size}")
+    # right-pad contiguity: pad marks end-of-row, never interior —
+    # after the first pad in a row, every later position must be pad
+    is_pad = arr == pad_id
+    interior = is_pad[:, :-1] & ~is_pad[:, 1:]
+    bad_rows = np.nonzero(interior.any(axis=1))[0]
+    if bad_rows.size:
+        errs.append(f"{path}: interior pad id in rows "
+                    f"{bad_rows[:8].tolist()} (padding must be a "
+                    "trailing run)")
+    return errs
+
+
+def main(argv) -> int:
+    if len(argv) < 2:
+        print("usage: validate_text.py <vocab.json> [packed.npy ...]",
+              file=sys.stderr)
+        return 2
+    vocab_path, batches = argv[1], argv[2:]
+    errors, vocab_size = validate_vocab(vocab_path)
+    for b in batches:
+        errors.extend(validate_packed(b, vocab_size))
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    print(f"{os.path.basename(vocab_path)}: vocab {vocab_size}, "
+          f"{len(batches)} packed batches, "
+          f"{'OK' if not errors else str(len(errors)) + ' errors'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -51,6 +51,14 @@ _LAZY = {
     "U8Codec": "tpudl.data",
     "BF16Codec": "tpudl.data",
     "ShardCache": "tpudl.data",
+    # text subsystem: tokenizer codec + LM pipeline stages (TEXT.md)
+    "ByteTokenizer": "tpudl.text",
+    "WordTokenizer": "tpudl.text",
+    "TokenCodec": "tpudl.text",
+    "lm_dataset": "tpudl.text",
+    "LMFeaturizer": "tpudl.ml",
+    "LMGenerator": "tpudl.ml",
+    "LMClassifier": "tpudl.ml",
     # long-context / sequence parallelism (TPU-native addition)
     "ring_attention": "tpudl.attention",
     "shard_sequence": "tpudl.attention",

@@ -32,7 +32,7 @@ class Knob:
     kind: str        # int | float | bool | str | enum | path | json
     default: str     # rendered default ("" = unset / derived)
     subsystem: str   # frame | data | obs | jobs | train | zoo |
-                     # compile | serve | bench
+                     # compile | serve | text | bench
     help: str        # one line, present tense
 
 
@@ -307,6 +307,25 @@ KNOBS: tuple[Knob, ...] = (
          "tail-exemplar gate: a completed request slower than k x the "
          "windowed median is captured with its segment breakdown into "
          "the error ring"),
+    # -- text plane (TEXT.md: tokenizer codec + LM stages) -------------
+    Knob("TPUDL_TEXT_WIRE_DTYPE", "enum", "", "text",
+         "TokenCodec wire dtype: u16|i32 (unset = auto: u16 when the "
+         "vocab fits 65536 ids, else i32); an explicit codec arg "
+         "always wins over the env"),
+    Knob("TPUDL_BENCH_LM_ROWS", "int", "192", "bench",
+         "lm_train sub-bench corpus row count (rounded down to full "
+         "frame batches for stable packed shapes)"),
+    Knob("TPUDL_BENCH_LM_SEQ", "int", "64", "bench",
+         "lm_train sub-bench packed sequence length (docs are sized "
+         "so each batch packs to exactly [batch, seq])"),
+    Knob("TPUDL_BENCH_LM_BATCH", "int", "32", "bench",
+         "lm_train sub-bench frame batch size (= packed rows per "
+         "train step)"),
+    Knob("TPUDL_BENCH_LM_PROMPTS", "int", "48", "bench",
+         "lm_generate sub-bench ragged prompt count (6 distinct "
+         "lengths cycled)"),
+    Knob("TPUDL_BENCH_LM_MAX_NEW", "int", "8", "bench",
+         "lm_generate sub-bench tokens generated per prompt"),
 )
 
 KNOB_NAMES = frozenset(k.name for k in KNOBS)

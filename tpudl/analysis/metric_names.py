@@ -329,6 +329,35 @@ METRICS: tuple[Metric, ...] = (
     Metric("serve.slo.exemplars", "counter",
            "tail exemplars captured into the error ring (latency > "
            "TPUDL_SERVE_SLO_TAIL_K x the windowed median)"),
+    # -- text plane (TEXT.md: tokenizer codec + LM stages) -------------
+    Metric("text.tokenize.calls", "counter",
+           "tokenize_pack invocations on the prepare pool (epoch-2 "
+           "delta MUST be 0 on a cached tokenized Dataset — the "
+           "zero-decode warm-replay evidence)"),
+    Metric("text.tokenize.tokens", "counter",
+           "token ids produced by tokenization (pre-padding)"),
+    Metric("text.tokenize.seconds", "histogram",
+           "host tokenize+pack latency per prepare call"),
+    Metric("text.pack.rows", "counter",
+           "packed batch rows emitted (ragged right-padded or dense "
+           "chunked)"),
+    Metric("text.pack.pad_tokens", "counter",
+           "pad ids written into packed batches (the padding tax "
+           "bucketing bounds)"),
+    Metric("text.pack.fill_pct", "gauge",
+           "real-token fraction of the last packed batch (100 = no "
+           "padding; dense packing pins this near 100)"),
+    Metric("lm.embed.rows", "counter",
+           "strings embedded by LMFeaturizer (masked mean-pooled "
+           "hidden states)"),
+    Metric("lm.classify.rows", "counter",
+           "strings labeled by LMClassifier (argmax over class-token "
+           "logits at the last real position)"),
+    Metric("lm.generate.requests", "counter",
+           "prompts completed by LMGenerator transforms"),
+    Metric("lm.generate.tokens", "counter",
+           "tokens generated by LMGenerator (post-EOS-trim; the "
+           "lm_generate bench rate numerator)"),
 )
 
 METRIC_NAMES = frozenset(m.name for m in METRICS if "*" not in m.name)

@@ -346,10 +346,16 @@ def resolve_codec(spec) -> "WireCodec | str | None":
         return IdentityCodec()
     if spec == "bf16":
         return BF16Codec()
+    if spec == "tokens":
+        # lazy: tpudl.text.codec imports this module, so the dependency
+        # must stay one-way at import time
+        from tpudl.text.codec import TokenCodec
+
+        return TokenCodec()
     if isinstance(spec, str):
         raise CodecError(
             f"unknown wire codec {spec!r}; known: "
-            "['auto', 'bf16', 'identity', 'u8']")
+            "['auto', 'bf16', 'identity', 'tokens', 'u8']")
     raise CodecError(f"wire codec must be a name or WireCodec, got "
                      f"{type(spec).__name__}")
 
@@ -365,6 +371,12 @@ def codec_from_key(key) -> WireCodec:
         return U8Codec(*key[1:])
     if name == "bf16":
         return BF16Codec()
+    if name == "tokens":
+        from tpudl.text.codec import TokenCodec
+
+        pad_id, vocab_size, wire = key[1:]
+        return TokenCodec(pad_id=pad_id, vocab_size=vocab_size,
+                          wire_dtype=wire)
     raise CodecError(f"unknown codec key {key!r}")
 
 

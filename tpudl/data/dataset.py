@@ -159,9 +159,16 @@ class Dataset:
             material = (cache_key_material
                         if cache_key_material is not None
                         else frame.fingerprint(self._cols))
+            # the pack fn is cache-key material exactly like the codec:
+            # a tokenizer pack's cache_token carries the vocab
+            # FINGERPRINT + packing geometry (tpudl.text.codec), so a
+            # changed vocab or seq_len is a cache miss, never a
+            # stale-ids replay
             key = cache_key(material, cols=",".join(self._cols),
                             batch=self._batch,
                             codec=_codec.spec_token(wire_codec),
+                            pack=("default" if pack is None
+                                  else _callable_token(pack)),
                             layout="dataset_v1")
         if cache_dir is not None:
             from tpudl.data.shards import ShardCache

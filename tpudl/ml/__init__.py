@@ -10,6 +10,7 @@ from tpudl.ml.classification import (LogisticRegression,
 from tpudl.ml.estimator import KerasImageFileEstimator
 from tpudl.ml.keras_image import KerasImageFileTransformer
 from tpudl.ml.keras_tensor import KerasTransformer
+from tpudl.ml.lm import LMClassifier, LMFeaturizer, LMGenerator
 from tpudl.ml.named_image import DeepImageFeaturizer, DeepImagePredictor
 from tpudl.ml.params import Param, Params, TypeConverters
 from tpudl.ml.pipeline import (Estimator, Model, Pipeline, PipelineModel,
@@ -27,6 +28,9 @@ __all__ = [
     "KerasTransformer",
     "KerasImageFileTransformer",
     "KerasImageFileEstimator",
+    "LMFeaturizer",
+    "LMGenerator",
+    "LMClassifier",
     "LogisticRegression",
     "LogisticRegressionModel",
     "Transformer",

@@ -24,19 +24,23 @@ __all__ = ["ModelEntry", "ModelRegistry"]
 
 
 class ModelEntry:
-    """One registered model: its engine plus warm-start forensics."""
+    """One registered model: its engine plus warm-start forensics.
+    ``tokenizer`` is set for text-serving entries (``add_generator``)
+    so the request path can encode prompts / decode completions with
+    the exact vocab the model was trained against."""
 
     __slots__ = ("name", "model", "params", "engine",
-                 "warm_signatures", "warm_s")
+                 "warm_signatures", "warm_s", "tokenizer")
 
     def __init__(self, name: str, model, params, engine: SlotDecoder,
-                 warm_signatures: int, warm_s: float):
+                 warm_signatures: int, warm_s: float, tokenizer=None):
         self.name = name
         self.model = model
         self.params = params
         self.engine = engine
         self.warm_signatures = warm_signatures
         self.warm_s = warm_s
+        self.tokenizer = tokenizer
 
 
 class ModelRegistry:
@@ -51,7 +55,7 @@ class ModelRegistry:
                  cache_len: int | None = None,
                  temperature: float = 0.0, prompt_buckets=True,
                  prompt_rungs=None, mesh=None, tp: bool = False,
-                 warm: bool = True) -> ModelEntry:
+                 warm: bool = True, tokenizer=None) -> ModelEntry:
         """Build the engine for ``model`` and (``warm=True``, store
         armed) AOT-warm its serve programs. ``prompt_rungs`` overrides
         the warmed prefill signature set; default is every ladder rung
@@ -79,12 +83,39 @@ class ModelRegistry:
                     block=True)
             warm_s = time.perf_counter() - t0
         entry = ModelEntry(str(name), model, params, engine, warm_n,
-                           warm_s)
+                           warm_s, tokenizer=tokenizer)
         with self._lock:
             self._entries[entry.name] = entry
             count = len(self._entries)
         _metrics.gauge("serve.models").set(count)
         return entry
+
+    def add_generator(self, name: str, generator, *,
+                      slots: int | None = None,
+                      cache_len: int | None = None,
+                      warm: bool = True) -> ModelEntry:
+        """Register an :class:`~tpudl.ml.lm.LMGenerator`'s signature for
+        online serving: the transformer already binds the model, the
+        weights, the sampling temperature, the prompt bucket ladder,
+        and the TOKENIZER — this unwraps them into :meth:`add_model`
+        (so the registered entry decodes through the continuous-
+        batching queue with exactly the offline stage's programs) and
+        files the tokenizer on the entry for the request path."""
+        missing = [k for k in ("model", "weights", "tokenizer")
+                   if getattr(generator, k, None) is None]
+        if missing:
+            raise ValueError(
+                f"add_generator needs a fully-bound LMGenerator "
+                f"(missing {missing})")
+        return self.add_model(
+            str(name), generator.model, generator.weights,
+            slots=slots, cache_len=cache_len,
+            temperature=float(generator.temperature),
+            prompt_buckets=(generator.promptBuckets
+                            if generator.promptBuckets is not None
+                            else True),
+            mesh=generator.mesh, tp=bool(generator.tp), warm=warm,
+            tokenizer=generator.tokenizer)
 
     def get(self, name: str) -> ModelEntry:
         with self._lock:

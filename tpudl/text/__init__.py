@@ -1,0 +1,29 @@
+"""tpudl.text — tokenizer column codec and sequence packing (TEXT.md).
+
+The subsystem that makes STRING columns first-class pipeline inputs:
+deterministic fingerprintable tokenizers (:mod:`tpudl.text.tokenizer`),
+the ``"tokens"`` wire codec + prepare-pool packing
+(:mod:`tpudl.text.codec`), and one-call LM training feeds
+(:mod:`tpudl.text.data`). The ml transformers over this layer live in
+:mod:`tpudl.ml.lm`; the SQL UDFs in :mod:`tpudl.udf.text_udf`.
+
+Import discipline: jax-free at import (tokenizer + packing run on the
+executor's prepare threads and in ``tools/validate_text.py``); only
+``TokenCodec.prologue`` / ``pad_mask`` touch jax, lazily.
+"""
+
+from tpudl.text.codec import (TokenCodec, lengths, pack_dense,
+                              pack_ragged, pad_mask, tokenize_pack)
+from tpudl.text.data import lm_dataset
+from tpudl.text.tokenizer import (BOS_ID, EOS_ID, PAD_ID, UNK_ID,
+                                  ByteTokenizer, Tokenizer,
+                                  WordTokenizer, load_vocab,
+                                  tokenizer_from_spec)
+
+__all__ = [
+    "PAD_ID", "BOS_ID", "EOS_ID", "UNK_ID",
+    "Tokenizer", "ByteTokenizer", "WordTokenizer",
+    "tokenizer_from_spec", "load_vocab",
+    "TokenCodec", "pad_mask", "lengths",
+    "pack_ragged", "pack_dense", "tokenize_pack", "lm_dataset",
+]

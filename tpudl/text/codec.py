@@ -1,0 +1,226 @@
+"""Token wire codec + sequence packing: ragged strings → rung-shaped
+int32 batches on the PR-4 wire machinery.
+
+Two halves, mirroring the image path exactly:
+
+- :class:`TokenCodec` is a :class:`~tpudl.data.codec.WireCodec` — token
+  ids ship as uint16 (vocab ≤ 65536, half the wire bytes) or int32, and
+  the device prologue is one ``astype(int32)`` fused in front of the
+  compiled program like the u8 pixel restore. EXACT by construction:
+  ids are integers, the cast is value-preserving, so host decode and
+  device prologue agree bitwise at every dtype. The codec registers
+  under the name ``"tokens"`` (``resolve_codec`` / ``codec_from_key``
+  in tpudl.data.codec), so shard manifests persist it and warm replays
+  reconstruct the identical prologue.
+
+- The PACK layer runs in the executor's prepare pool (a ``pack=``
+  callable for ``Frame.map_batches`` / ``Dataset``):
+  :func:`tokenize_pack` builds the string-column → int32-batch pack fn,
+  either rung-padded ragged rows (:func:`pack_ragged`, inference /
+  featurize) or a densely packed token stream chunked to ``seq_len``
+  rows (:func:`pack_dense`, LM training — pad waste only in the final
+  row). The pack fn carries ``cache_token`` = tokenizer fingerprint +
+  packing config, which is how tokenization becomes shard-cache /
+  DeviceBatchCache key material: epoch 2 of a tokenized fine-tune
+  replays resident batches with ZERO re-tokenizations and ZERO wire
+  bytes, and a changed vocab or seq_len re-keys the cache instead of
+  replaying stale ids.
+
+Padding semantics (TEXT.md): pad id is 0, right-padding only; the
+attention story is ``pad_mask(tokens)`` INSIDE the jitted model fn —
+computed on device from the shipped ids, so no mask crosses the wire
+and the mask op fuses into the one program.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from tpudl.compile.buckets import BucketLadder, resolve_ladder
+from tpudl.data.codec import CodecError, WireCodec
+from tpudl.text.tokenizer import PAD_ID, Tokenizer
+
+__all__ = ["TokenCodec", "pad_mask", "lengths", "pack_ragged",
+           "pack_dense", "tokenize_pack"]
+
+
+def _wire_dtype(requested: str, vocab_size) -> str:
+    """Resolve the wire dtype: explicit arg beats ``TPUDL_TEXT_WIRE_DTYPE``
+    beats auto (u16 whenever the vocab provably fits — token ids are the
+    ONE tensor whose value range is declared up front, so the 2× shrink
+    needs no probe)."""
+    req = requested or "auto"
+    if req == "auto":
+        req = os.environ.get("TPUDL_TEXT_WIRE_DTYPE", "") or "auto"
+    if req == "auto":
+        req = ("u16" if vocab_size is not None
+               and int(vocab_size) <= (1 << 16) else "i32")
+    if req not in ("u16", "i32"):
+        raise CodecError(
+            f"unknown token wire dtype {req!r}; known: ['auto', 'i32', "
+            "'u16']")
+    if req == "u16" and vocab_size is not None \
+            and int(vocab_size) > (1 << 16):
+        raise CodecError(
+            f"u16 token wire cannot carry vocab_size={vocab_size} "
+            "(> 65536); use 'i32'")
+    return req
+
+
+class TokenCodec(WireCodec):
+    """Integer token ids on the wire — uint16 when the vocab fits
+    (2× fewer bytes than the int32 the model consumes), restored on
+    device by one fused ``astype(int32)``. Unlike the pixel codecs this
+    one also VALIDATES: encode bounds-checks every batch against the
+    declared ``vocab_size`` (and the u16 ceiling), so an id produced by
+    the wrong tokenizer fails loudly host-side instead of gathering a
+    garbage embedding row on device."""
+
+    name = "tokens"
+
+    def __init__(self, *, pad_id: int = PAD_ID, vocab_size=None,
+                 wire_dtype: str = "auto"):
+        self.pad_id = int(pad_id)
+        self.vocab_size = None if vocab_size is None else int(vocab_size)
+        self.wire = _wire_dtype(wire_dtype, self.vocab_size)
+
+    def key(self) -> tuple:
+        return (self.name, self.pad_id, self.vocab_size, self.wire)
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.asarray(arr)
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise CodecError(
+                f"tokens codec encodes integer id batches, got {arr.dtype}")
+        if arr.size:
+            lo, hi = int(arr.min()), int(arr.max())
+            if lo < 0:
+                raise CodecError(f"token ids must be >= 0 (min {lo})")
+            limit = (self.vocab_size if self.vocab_size is not None
+                     else (1 << 16) if self.wire == "u16" else None)
+            if limit is not None and hi >= limit:
+                raise CodecError(
+                    f"token id {hi} out of range for vocab_size={limit} "
+                    "— wrong tokenizer for this model?")
+        return arr.astype(np.uint16 if self.wire == "u16" else np.int32)
+
+    def decode_array(self, arr: np.ndarray) -> np.ndarray:
+        return np.asarray(arr).astype(np.int32)
+
+    def prologue(self, x):
+        import jax.numpy as jnp
+
+        return x.astype(jnp.int32)
+
+    def dense_nbytes(self, encoded: np.ndarray) -> int:
+        return int(encoded.size) * 4  # the int32 the model consumes
+
+
+def pad_mask(tokens, pad_id: int = PAD_ID):
+    """float32 attention mask (1 = real, 0 = pad) computed ON DEVICE
+    from the shipped ids — jittable, so calling it first thing inside
+    the model fn fuses the mask into the one compiled program and
+    nothing mask-shaped ever crosses the wire."""
+    import jax.numpy as jnp
+
+    return (tokens != pad_id).astype(jnp.float32)
+
+
+def lengths(batch, pad_id: int = PAD_ID) -> np.ndarray:
+    """Host-side per-row real lengths of a right-padded batch (int32);
+    the inverse of what packing erased. Counts non-pad ids — valid
+    because packing only ever right-pads with ``pad_id``."""
+    return (np.asarray(batch) != pad_id).sum(axis=1).astype(np.int32)
+
+
+def pack_ragged(seqs, *, buckets="pow2", pad_id: int = PAD_ID,
+                max_len=None) -> np.ndarray:
+    """Ragged id vectors → one right-padded int32 batch whose seq dim
+    snaps to a bucket-ladder rung (the PR-15 discipline applied to the
+    SEQUENCE axis): a ragged prompt sweep hits O(log n) compiled
+    signatures instead of one per novel length."""
+    ladder = resolve_ladder(buckets if buckets is not None else "pow2")
+    seqs = [np.asarray(s, dtype=np.int32).reshape(-1) for s in seqs]
+    longest = max((len(s) for s in seqs), default=0)
+    if max_len is not None:
+        longest = min(longest, int(max_len))
+    width = max(1, ladder.pick(longest) if ladder is not None else longest)
+    out = np.full((len(seqs), width), int(pad_id), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        s = s[:width]
+        out[i, : len(s)] = s
+    return out
+
+
+def pack_dense(seqs, seq_len: int, *, pad_id: int = PAD_ID) -> np.ndarray:
+    """Dense LM-training packing: concatenate the id streams and chunk
+    into ``seq_len`` rows — pad waste only in the final partial row
+    (the separator policy — eos between documents — is the tokenizer
+    call's ``eos=True``, upstream of here). Always emits at least one
+    row so a batch of empty strings still has the declared shape."""
+    seq_len = int(seq_len)
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    flat = (np.concatenate([np.asarray(s, dtype=np.int32).reshape(-1)
+                            for s in seqs])
+            if len(seqs) else np.zeros(0, dtype=np.int32))
+    n_rows = max(1, -(-int(flat.size) // seq_len))
+    out = np.full(n_rows * seq_len, int(pad_id), dtype=np.int32)
+    out[: flat.size] = flat
+    return out.reshape(n_rows, seq_len)
+
+
+def tokenize_pack(tokenizer: Tokenizer, *, seq_len=None, buckets="pow2",
+                  pad_id: int = PAD_ID, bos: bool = False,
+                  eos: bool = False, dense: bool = False):
+    """Build the string-column pack fn for ``Frame.map_batches(pack=)``
+    / ``Dataset(pack=)`` — tokenize + pack runs on the prepare pool's
+    threads, overlapped with device compute like image decode.
+
+    ``dense=True`` (requires ``seq_len``) emits the training layout
+    (:func:`pack_dense`); otherwise rows stay 1:1 with input strings,
+    right-padded to a ladder rung (:func:`pack_ragged`, capped at
+    ``seq_len`` when given).
+
+    The returned fn's ``cache_token`` folds in the tokenizer
+    FINGERPRINT and every packing parameter — the shard-cache /
+    device-cache key material that makes epoch ≥ 2 a zero-tokenize,
+    zero-wire replay (and makes a vocab edit a cache miss, never a
+    stale-ids replay)."""
+    if dense and seq_len is None:
+        raise ValueError("dense packing requires seq_len")
+    ladder = resolve_ladder(buckets if buckets is not None else "pow2")
+
+    def pack(col) -> np.ndarray:
+        from tpudl.obs import metrics as _m
+
+        t0 = time.perf_counter()
+        seqs = tokenizer.encode_batch(list(np.asarray(col, dtype=object)),
+                                      bos=bos, eos=eos)
+        n_tok = int(sum(len(s) for s in seqs))
+        _m.counter("text.tokenize.calls").inc()
+        _m.counter("text.tokenize.tokens").inc(n_tok)
+        _m.histogram("text.tokenize.seconds").observe(
+            time.perf_counter() - t0)
+        if dense:
+            out = pack_dense(seqs, int(seq_len), pad_id=pad_id)
+        else:
+            out = pack_ragged(seqs, buckets=ladder, pad_id=pad_id,
+                              max_len=seq_len)
+        _m.counter("text.pack.rows").inc(int(out.shape[0]))
+        pad_tokens = int(out.size) - min(n_tok, int(out.size))
+        _m.counter("text.pack.pad_tokens").inc(pad_tokens)
+        if out.size:
+            _m.gauge("text.pack.fill_pct").set(
+                100.0 * (1.0 - pad_tokens / out.size))
+        return out
+
+    spec = (ladder.spec if ladder is not None else "off")
+    pack.cache_token = (
+        f"text.pack:{tokenizer.cache_token}|seq={seq_len}|dense={dense}"
+        f"|buckets={spec}|pad={int(pad_id)}|bos={bos}|eos={eos}")
+    pack.tokenizer = tokenizer
+    return pack

@@ -1,3 +1,4 @@
 from tpudl.udf import registry  # noqa: F401
 from tpudl.udf.registry import get_udf, list_udfs, register_udf  # noqa: F401
 from tpudl.udf.tensorframes_udf import makeGraphUDF  # noqa: F401
+from tpudl.udf.text_udf import register_text_udfs  # noqa: F401

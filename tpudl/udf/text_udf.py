@@ -1,0 +1,95 @@
+"""register_text_udfs — the LM trio as SQL UDFs.
+
+The text analogue of :func:`tpudl.udf.tensorframes_udf.makeGraphUDF`:
+one call registers ``generate`` / ``embed`` / ``classify`` (optionally
+prefixed) over a string column, each backed by the corresponding
+:mod:`tpudl.ml.lm` transformer built ONCE at registration — the
+transformer instance retains its compiled-program cache, so repeated
+SQL queries reuse the same bucketed XLA programs:
+
+    register_text_udfs(model=lm, weights=params, tokenizer=tok,
+                       max_new=8)
+    sql("SELECT generate(prompt) AS story FROM t", {"t": frame})
+
+Instrumentation matches makeGraphUDF exactly: per-UDF ``udf.<name>``
+heartbeat + latency histogram + host span, ``udf.<name>.calls`` /
+``udf.<name>.rows`` counters, so a SQL query's LM cost is attributable
+from one metrics snapshot.
+"""
+
+from __future__ import annotations
+
+from tpudl.obs import metrics as _obs_metrics
+from tpudl.obs import tracer as _obs_tracer
+from tpudl.obs import watchdog as _obs_watchdog
+from tpudl.udf.registry import UDF, register_udf
+
+__all__ = ["register_text_udfs"]
+
+
+def _wrap(udf_name: str, transformer, input_col: str, out_col: str,
+          batch_size: int, register: bool) -> UDF:
+    def frame_fn(frame):
+        with _obs_watchdog.heartbeat(f"udf.{udf_name}",
+                                     rows=len(frame),
+                                     batch_size=batch_size), \
+                _obs_metrics.timed(f"udf.{udf_name}.seconds"), \
+                _obs_tracer.span(f"udf.{udf_name}", rows=len(frame)):
+            out = transformer.transform(frame)
+        _obs_metrics.counter(f"udf.{udf_name}.calls").inc()
+        _obs_metrics.counter(f"udf.{udf_name}.rows").inc(len(frame))
+        return out
+
+    if register:
+        return register_udf(udf_name, frame_fn, input_col, out_col)
+    return UDF(str(udf_name), frame_fn, input_col, out_col)
+
+
+def register_text_udfs(*, model, weights, tokenizer,
+                       input_col: str = "text", prefix: str = "",
+                       max_new: int = 16, temperature: float = 0.0,
+                       seed: int = 0, classes=None, max_len=None,
+                       prompt_buckets="pow2", batch_size: int = 32,
+                       mesh=None, tp: bool = False,
+                       register: bool = True) -> list[UDF]:
+    """Register the LM UDF family over ``model``/``weights``/``tokenizer``.
+
+    Always registers ``{prefix}generate`` (→ completion string,
+    :class:`~tpudl.ml.lm.LMGenerator`) and ``{prefix}embed`` (→ pooled
+    hidden vector, :class:`~tpudl.ml.lm.LMFeaturizer`); with
+    ``classes=[...]`` also ``{prefix}classify`` (→ label string,
+    :class:`~tpudl.ml.lm.LMClassifier`). ``input_col`` names the string
+    column the transformers read — SQL's ``fn(col)`` grammar renames
+    the bound column to it, so any column name works at the call site.
+    ``register=False`` builds and returns the UDFs without filing them.
+    Returns the UDF list in registration order.
+    """
+    from tpudl.ml.lm import LMClassifier, LMFeaturizer, LMGenerator
+
+    out = []
+    name = f"{prefix}generate"
+    gen = LMGenerator(inputCol=input_col, outputCol=f"{name}_out",
+                      model=model, weights=weights, tokenizer=tokenizer,
+                      maxNew=max_new, temperature=temperature, seed=seed,
+                      promptBuckets=prompt_buckets, batchSize=batch_size,
+                      mesh=mesh, tp=tp)
+    out.append(_wrap(name, gen, input_col, f"{name}_out", batch_size,
+                     register))
+    name = f"{prefix}embed"
+    feat = LMFeaturizer(inputCol=input_col, outputCol=f"{name}_out",
+                        model=model, weights=weights,
+                        tokenizer=tokenizer, maxLen=max_len,
+                        promptBuckets=prompt_buckets,
+                        batchSize=batch_size, mesh=mesh, tp=tp)
+    out.append(_wrap(name, feat, input_col, f"{name}_out", batch_size,
+                     register))
+    if classes:
+        name = f"{prefix}classify"
+        clf = LMClassifier(inputCol=input_col, outputCol=f"{name}_out",
+                           model=model, weights=weights,
+                           tokenizer=tokenizer, classes=classes,
+                           maxLen=max_len, promptBuckets=prompt_buckets,
+                           batchSize=batch_size, mesh=mesh, tp=tp)
+        out.append(_wrap(name, clf, input_col, f"{name}_out",
+                         batch_size, register))
+    return out

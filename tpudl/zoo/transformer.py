@@ -249,6 +249,17 @@ class TinyCausalLM:
         under ``jax.jit``, as the Trainer always runs; eager
         checkpoint-of-shard_map is unsupported upstream) and the Pallas
         kernels (the custom VJP re-runs the tiled forward)."""
+        x = self.hidden(params, tokens, mesh=mesh, use_pallas=use_pallas,
+                        remat=remat, tp=tp)
+        return x @ params["embed"]["table"].T              # tied head
+
+    def hidden(self, params, tokens, *, mesh=None, use_pallas: bool = False,
+               remat: bool = False, tp: bool = False):
+        """tokens [B, S] int32 → final-norm hidden states [B, S, D] —
+        :meth:`apply` minus the tied head projection. The embedding
+        surface the LMFeaturizer pools (pre-logits representations are
+        the standard text-feature contract), sharing the block body so
+        the featurize and generate paths can never diverge on math."""
         from tpudl.attention import attention_reference, ring_attention
 
         b, s = tokens.shape
@@ -283,8 +294,7 @@ class TinyCausalLM:
             block = jax.checkpoint(block)
         for i in range(self.layers):
             x = block(x, params[f"block_{i}"])
-        x = _layer_norm(x, params["final_norm"])
-        return x @ params["embed"]["table"].T              # tied head
+        return _layer_norm(x, params["final_norm"])
 
     def apply_pipelined(self, params, tokens, mesh, *,
                         pipe_axis: str = "model", n_micro: int = 2,
