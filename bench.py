@@ -381,6 +381,14 @@ def _compact_summary(record: dict) -> dict:
             # saturation under load — plus the ISSUE-18 windowed pair
             # (SLO-engine recent p99 + burn) beside the lifetime p99
             s[k] = _scalar(sv[k])
+    if sv.get("tenants"):
+        # the ISSUE-20 one-liners: how many attribution scopes the
+        # two-tenant serve load produced, and whether their ledger
+        # reconciled exactly against the global counters (the full
+        # per-tenant block stays on the trial record — too nested for
+        # the judged line)
+        s["serve_tenants"] = len(sv["tenants"])
+        s["serve_ledger_ok"] = bool(sv.get("ledger_ok"))
     lt = record.get("lm_train") or {}
     for k in ("lm_train_tokens_per_sec", "lm_warm_epoch_speedup",
               "lm_epoch2_tokenize_calls", "lm_epoch2_wire_bytes"):
@@ -2176,11 +2184,20 @@ def run_serve_child(out_path):
 
     srv = S.Server(reg).start_async()
     try:
+        # two-tenant attribution (ISSUE 20): clients alternate between
+        # tenants "a" and "b", so the child's ledger carries two scope
+        # rows and the reconciliation invariant is exercised end to end
+        # under real closed-loop serve load
         load = S.run_closed_loop(srv, make_prompt, requests=n,
-                                 clients=clients, max_new=8)
+                                 clients=clients, max_new=8,
+                                 tenant=("a", "b"))
     finally:
         srv.close()
     _compile.get_program_store().drain(180)  # the warm arm reads this
+    from tpudl.obs import attribution as _attr
+
+    ledger = _attr.ledger_snapshot()
+    ledger["reconcile"] = _attr.reconcile()
     snap = obs.snapshot()
     occ = (snap.get("serve.batch_occupancy") or {}).get("value")
     # the WINDOWED SLO view (ISSUE 18): same run, but recent-window
@@ -2202,7 +2219,13 @@ def run_serve_child(out_path):
                    "rejected": load["rejected"],
                    "batch_occupancy": occ,
                    "slo_window_p99_ms": slo_view.get("window_p99_ms"),
-                   "slo_burn": slo_view.get("burn_short")}, f)
+                   "slo_burn": slo_view.get("burn_short"),
+                   # the attribution evidence: the full per-tenant
+                   # ledger block (validate_dump.validate_ledger_section
+                   # schema) plus the scalars the judged line carries
+                   "ledger": ledger,
+                   "tenants": sorted(ledger["scopes"]),
+                   "ledger_ok": bool(ledger["reconcile"]["ok"])}, f)
 
 
 def measure_serve():
@@ -2289,6 +2312,12 @@ def measure_serve():
                                 if slo_p99s else None)
     out["slo_burn"] = (round(statistics.median(burns), 3)
                        if burns else None)
+    # the two-tenant attribution evidence (ISSUE 20) from the last warm
+    # arm: the per-tenant ledger block rides on the trial record, the
+    # tenant count and reconciliation verdict on the judged line
+    out["ledger"] = last.get("ledger")
+    out["tenants"] = last.get("tenants") or []
+    out["ledger_ok"] = last.get("ledger_ok")
     log(f"serve A/B: cold TTFT {cold_ttft:.2f}s vs warm "
         f"{warm_ttft:.2f}s ({out.get('serve_ttft_speedup')}x, "
         f"{out['aot_programs_restored']} programs restored) | "

@@ -78,6 +78,13 @@ echo "== text subset (ISSUE 19: tokenizer codec + tokens/s acceptance) =="
 # own line.
 python -m pytest tests/test_text.py -q "$@"
 
+echo "== attribution subset (ISSUE 20: scoped ledgers acceptance) =="
+# Target the attribution module DIRECTLY (same rationale as the armed
+# concurrency subset above): the two-tenant acceptance (serve loop +
+# concurrent fit reconciling exactly), the cross-pool scope carries
+# and the TSAN-armed ledger pass must fail loudly on their own line.
+python -m pytest tests/test_obs_attribution.py -q "$@"
+
 echo "== pytest (simulated 8-device CPU mesh) =="
 python -m pytest tests/ -q "$@"
 

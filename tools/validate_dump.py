@@ -25,7 +25,7 @@ import sys
 
 _NUM = (int, float)
 SCHEMA = "tpudl-flight-dump"
-VERSION = 2
+VERSION = 3
 
 # key -> required python types of the top-level payload
 _TOP_KEYS = {
@@ -70,6 +70,17 @@ _REQUEST_KEYS = {"ts": _NUM, "trace_id": (str, type(None)),
                  "segments": (dict, type(None))}
 # keys that would mean a request descriptor leaked content
 _REQUEST_FORBIDDEN = ("prompt", "tokens", "text")
+# the attribution ledger (version >= 3): per-scope running aggregates
+# — mirrors tpudl.obs.attribution.LEDGER_FIELDS (kept literal here:
+# the validator family is pure stdlib, importable without tpudl)
+_LEDGER_FIELDS = ("rows_in", "rows_out", "tokens_in", "tokens_out",
+                  "wire_bytes", "hbm_bytes", "hbm_peak_bytes",
+                  "dispatch_s", "compile_s", "retries", "degradations",
+                  "serve_completed", "slo_samples")
+# one status/dump ledger holds at most this many scope rows: the table
+# is LRU-bounded at TPUDL_OBS_SCOPES (default 64) — orders of magnitude
+# past this means the cardinality guard broke
+_LEDGER_SCOPES_CAP = 4096
 
 
 def _check_keys(obj: dict, spec: dict, where: str) -> list[str]:
@@ -80,6 +91,69 @@ def _check_keys(obj: dict, spec: dict, where: str) -> list[str]:
         elif not isinstance(obj[key], types):
             errs.append(f"{where}: {key}={type(obj[key]).__name__} "
                         f"is not {types}")
+    return errs
+
+
+def validate_ledger_section(led, where: str = "ledger") -> list[str]:
+    """Errors in one attribution-ledger section (shared by the dump
+    and status validators — the section's shape is identical, the
+    status flavor just adds per-row rates/shares). Bound audit rides
+    along: the scope table must stay LRU-capped."""
+    if led is None:
+        return []
+    if not isinstance(led, dict):
+        return [f"{where}: not an object"]
+    errs = []
+    scopes = led.get("scopes")
+    if not isinstance(scopes, dict):
+        errs.append(f"{where}.scopes: missing/not an object")
+        scopes = {}
+    cap = led.get("cap")
+    if not isinstance(cap, int) or cap < 1:
+        errs.append(f"{where}.cap: {cap!r} is not a positive int")
+    if len(scopes) > _LEDGER_SCOPES_CAP \
+            or (isinstance(cap, int) and cap >= 1
+                and len(scopes) > cap):
+        errs.append(f"{where}.scopes: {len(scopes)} rows past the "
+                    f"cardinality bound (cap {cap})")
+    evicted = led.get("evicted")
+    if not isinstance(evicted, int) or evicted < 0:
+        errs.append(f"{where}.evicted: {evicted!r} is not a "
+                    "non-negative int")
+    rows = [(f"{where}.scopes[{k}]", v) for k, v in scopes.items()]
+    rows.append((f"{where}.unattributed", led.get("unattributed")))
+    for rw, row in rows:
+        if not isinstance(row, dict):
+            errs.append(f"{rw}: not an object")
+            continue
+        for f in _LEDGER_FIELDS:
+            if not isinstance(row.get(f), _NUM):
+                errs.append(f"{rw}.{f}: missing/not numeric")
+        share = row.get("hbm_share")
+        if share is not None and (not isinstance(share, _NUM)
+                                  or not 0 <= share <= 1.0001):
+            errs.append(f"{rw}.hbm_share: {share!r} is not a fraction")
+        for f in ("rows_s", "tokens_s"):
+            v = row.get(f)
+            if v is not None and not isinstance(v, _NUM):
+                errs.append(f"{rw}.{f}: {type(v).__name__} is not "
+                            "numeric")
+    rec = led.get("reconcile")
+    if rec is not None:
+        if not isinstance(rec, dict) \
+                or not isinstance(rec.get("ok"), bool) \
+                or not isinstance(rec.get("checks"), list):
+            errs.append(f"{where}.reconcile: must carry ok: bool + "
+                        "checks: list")
+        else:
+            for j, c in enumerate(rec["checks"]):
+                if not isinstance(c, dict) \
+                        or not isinstance(c.get("field"), str) \
+                        or not isinstance(c.get("ledger"), _NUM) \
+                        or not isinstance(c.get("global"), _NUM) \
+                        or not isinstance(c.get("ok"), bool):
+                    errs.append(f"{where}.reconcile.checks[{j}]: "
+                                "malformed check entry")
     return errs
 
 
@@ -99,6 +173,16 @@ def validate_payload(payload) -> list[str]:
     if isinstance(payload.get("version"), int) \
             and payload["version"] >= 2:
         errs.extend(_check_keys(payload, {"requests": list}, "dump"))
+    # the attribution ledger arrived with version 3 (same back-compat
+    # shape: older dumps without it stay valid; a v3 dump must carry
+    # the key — None marks a dying-interpreter gap, a dict is audited)
+    if isinstance(payload.get("version"), int) \
+            and payload["version"] >= 3:
+        if "ledger" not in payload:
+            errs.append("dump: missing key 'ledger'")
+        else:
+            errs.extend(f"dump: {e}" for e in validate_ledger_section(
+                payload["ledger"]))
     # ring bounds: a leaked (unbounded) recorder shows up here
     for ring, cap in _RING_CAPS.items():
         entries = payload.get(ring)

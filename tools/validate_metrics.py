@@ -19,6 +19,14 @@ sink file may legitimately carry user-defined metrics, but tpudl's own
 emissions must match the schema the dashboards and the bench sentinel
 key on.
 
+Always-on fourth contract (ISSUE 20): the labeled-series bound. The
+attribution plane keeps per-tenant aggregates in ONE bounded ledger
+precisely so nobody multiplies metric names by scope; a snapshot whose
+name family (first two dot segments) holds more distinct series than
+``--series-bound`` (default 256) is a cardinality explosion — someone
+is minting per-label names into the registry — and exits rc 2, louder
+than a schema error.
+
 Pure stdlib (the registry import is lazy, only under ``--check-names``),
 importable (``from validate_metrics import ...``) and runnable
 (``python tools/validate_metrics.py <file.jsonl>``).
@@ -41,6 +49,10 @@ _METRIC_KEYS = {
 }
 SUMMARY_REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline")
 SUMMARY_MAX_CHARS = 1500
+# cardinality bound per name family in one snapshot: generously above
+# any legitimate tpudl prefix (serve.* tops out around a dozen), far
+# below what per-tenant name-minting produces
+SERIES_BOUND = 256
 
 
 def validate_metric_entry(name: str, entry) -> list[str]:
@@ -166,24 +178,81 @@ def check_file_names(path: str) -> list[str]:
     return sorted(unknown)
 
 
+def series_family(name: str) -> str:
+    """A metric name's cardinality family: the first two dot segments
+    (``serve.slo.burn_short`` → ``serve.slo``). Per-label name minting
+    multiplies series INSIDE one family, which is what the bound
+    catches."""
+    return ".".join(str(name).split(".")[:2])
+
+
+def labeled_series_breaches(path: str,
+                            bound: int = SERIES_BOUND) -> list[str]:
+    """Families whose distinct-series count in any single snapshot
+    line breaches ``bound`` (empty = cardinality healthy). Counted per
+    LINE, not across the file — a long-lived sink legitimately
+    accumulates history, but one snapshot is one registry."""
+    worst: dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # the schema pass reports these
+            metrics = obj.get("metrics") if isinstance(obj, dict) \
+                else None
+            if not isinstance(metrics, dict):
+                continue
+            fams: dict[str, int] = {}
+            for name in metrics:
+                fam = series_family(name)
+                fams[fam] = fams.get(fam, 0) + 1
+            for fam, n in fams.items():
+                if n > worst.get(fam, 0):
+                    worst[fam] = n
+    return [f"family {fam!r}: {n} distinct series in one snapshot "
+            f"(labeled-series bound {bound}; keep per-scope aggregates "
+            f"in the attribution ledger, not in metric names)"
+            for fam, n in sorted(worst.items()) if n > bound]
+
+
 def main(argv) -> int:
     args = list(argv[1:])
     check_names = "--check-names" in args
     if check_names:
         args.remove("--check-names")
+    bound = SERIES_BOUND
+    if "--series-bound" in args:
+        at = args.index("--series-bound")
+        try:
+            bound = int(args[at + 1])
+        except (IndexError, ValueError):
+            print("--series-bound needs an integer", file=sys.stderr)
+            return 2
+        del args[at:at + 2]
     if len(args) != 1:
         print("usage: validate_metrics.py [--check-names] "
-              "<metrics.jsonl>", file=sys.stderr)
+              "[--series-bound N] <metrics.jsonl>", file=sys.stderr)
         return 2
     errors, n, _last = validate_metrics_file(args[0])
     if check_names:
         errors.extend(f"undeclared metric name: {name!r} (declare it "
                       f"in tpudl/analysis/metric_names.py)"
                       for name in check_file_names(args[0]))
+    breaches = labeled_series_breaches(args[0], bound)
     for e in errors:
         print(f"INVALID: {e}", file=sys.stderr)
+    for b in breaches:
+        print(f"CARDINALITY: {b}", file=sys.stderr)
+    n_bad = len(errors) + len(breaches)
     print(f"{args[0]}: {n} lines, "
-          f"{'OK' if not errors else str(len(errors)) + ' errors'}")
+          f"{'OK' if not n_bad else str(n_bad) + ' errors'}")
+    # rc contract: a cardinality breach outranks schema errors (2) —
+    # it is the signal the attribution plane's guard exists to raise
+    if breaches:
+        return 2
     return 1 if errors else 0
 
 

@@ -155,6 +155,18 @@ def validate_payload(payload) -> list[str]:
                     if not isinstance(v, _NUM) or not 0 <= v <= 1.0001:
                         errs.append(f"roofline.gap_attribution[{k}]: "
                                     f"{v!r} is not a fraction")
+    # the attribution ledger section (optional, like serve/hbm/compile:
+    # present once anything charged); the shape is the dump validator's
+    # — shared checker, status rows just add rates/shares it tolerates
+    if "ledger" in payload:
+        try:
+            from validate_dump import validate_ledger_section
+
+            errs.extend(f"status: {e}" for e in
+                        validate_ledger_section(payload["ledger"]))
+        except ImportError:
+            if not isinstance(payload["ledger"], (dict, type(None))):
+                errs.append("status: ledger: not an object")
     # metrics entries reuse the sink's typed schema when importable
     try:
         from validate_metrics import validate_metric_entry

@@ -113,6 +113,9 @@ KNOBS: tuple[Knob, ...] = (
          "here (unset = off)"),
     Knob("TPUDL_STATUS_INTERVAL_S", "float", "1.0", "obs",
          "live status writer period (floor 0.05)"),
+    Knob("TPUDL_OBS_SCOPES", "int", "64", "obs",
+         "attribution-ledger cardinality bound: live scope rows kept "
+         "before LRU eviction folds the oldest into unattributed"),
     Knob("TPUDL_WATCHDOG_STALL_S", "float", "0", "obs",
          "heartbeat age that flags a stall; > 0 lazily starts the "
          "watchdog daemon (0/unset = off)"),

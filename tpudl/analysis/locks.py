@@ -159,6 +159,12 @@ LOCKS: tuple[LockDecl, ...] = (
              "SloEngine windowed stamp ring + cached median + publish "
              "throttle (gauges and exemplar writes happen outside "
              "the lock)"),
+    LockDecl("obs.attribution.ledger", "tpudl.obs.attribution", "lock",
+             "instance", 26,
+             "ScopeLedger scope table + unattributed bucket (LRU "
+             "bookkeeping and folds under the lock; the eviction "
+             "counter publishes after release — charges nest under "
+             "any caller lock but acquire nothing themselves)"),
     # -- rank 30: leaf scalar locks (never acquire anything under) -----
     LockDecl("obs.metrics.counter", "tpudl.obs.metrics", "lock",
              "instance", 30, "one Counter's running value"),

@@ -329,6 +329,11 @@ METRICS: tuple[Metric, ...] = (
     Metric("serve.slo.exemplars", "counter",
            "tail exemplars captured into the error ring (latency > "
            "TPUDL_SERVE_SLO_TAIL_K x the windowed median)"),
+    # -- attribution plane (ISSUE 20: tpudl.obs.attribution) -----------
+    Metric("attribution.scopes_evicted", "counter",
+           "ledger scope rows LRU-evicted at the TPUDL_OBS_SCOPES "
+           "cardinality bound (totals fold into the unattributed "
+           "bucket — the reconciliation invariant survives)"),
     # -- text plane (TEXT.md: tokenizer codec + LM stages) -------------
     Metric("text.tokenize.calls", "counter",
            "tokenize_pack invocations on the prepare pool (epoch-2 "

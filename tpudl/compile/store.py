@@ -641,6 +641,15 @@ class ProgramStore:
         if mm is not None:
             mm.counter("compile.programs_compiled").inc()
             mm.counter("compile.aot_s").inc(dt)
+            # attribution pairing with compile.aot_s (same guard: a
+            # minimal subprocess without obs charges neither side): a
+            # dispatch-path miss charges the dispatching scope (carried
+            # onto the window thread); a background warm/restore build
+            # runs scope-free and lands in unattributed — both
+            # reconcile
+            from tpudl.obs import attribution as _attr
+
+            _attr.charge("compile_s", dt)
         return compiled
 
     def _persist_task(self, key, compiled, portable) -> None:

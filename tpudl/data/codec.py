@@ -515,6 +515,7 @@ class CodecPlan:
         """Wire-byte accounting for one prepared batch — called for
         encoded AND cache-hit batches (a replayed shard still crosses
         the wire)."""
+        from tpudl.obs import attribution as _attr
         from tpudl.obs import metrics as _m
 
         shipped = dense = 0
@@ -523,6 +524,10 @@ class CodecPlan:
             shipped += int(np.asarray(arr).nbytes)
             dense += codec.dense_nbytes(np.asarray(arr))
         _m.counter("data.wire.bytes_shipped").inc(shipped)
+        # attribution pairing (tpudl.obs.attribution): the SAME amount
+        # as the global counter, so per-scope sums + unattributed
+        # reconcile exactly against data.wire.bytes_shipped
+        _attr.charge("wire_bytes", shipped)
         _m.counter("data.wire.bytes_dense").inc(dense)
         if dense > shipped:
             _m.counter("data.wire.bytes_saved").inc(dense - shipped)

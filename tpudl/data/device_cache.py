@@ -224,7 +224,7 @@ class Pin:
 
 class _Entry:
     __slots__ = ("key", "arrays", "n_pad", "codecs", "nbytes", "pins",
-                 "resident")
+                 "resident", "owner")
 
     def __init__(self, key, arrays, n_pad, codecs):
         self.key = key
@@ -237,6 +237,11 @@ class _Entry:
         # False once evicted/cleared: an outstanding Pin's late release
         # must not adjust tallies for an entry no longer in the map
         self.resident = False
+        # attribution scope key charged for these bytes at put() — an
+        # eviction from ANY run/thread credits this owner, so the
+        # per-scope HBM ledger never leaks an evicted entry's bytes
+        # onto whoever happened to trigger the eviction
+        self.owner = None
 
     @property
     def run(self):
@@ -355,6 +360,7 @@ class DeviceBatchCache:
           worse than cache-off). The prefix that fits stays resident;
           the tail stays a plain wire transfer. Cross-run reclaim
           (stale entries of a previous dataset) still evicts."""
+        from tpudl.obs import attribution as _attr
         from tpudl.obs import metrics as _m
 
         try:
@@ -367,8 +373,14 @@ class DeviceBatchCache:
         except Exception:
             count_put_failed()
             return None
+        # owner resolved BEFORE the entry becomes visible in the map,
+        # so a concurrent eviction always finds the right scope to
+        # credit (the charge itself happens after the lock)
+        sc = _attr.current_scope()
+        entry.owner = sc.key if sc is not None else None
         run = entry.run
         evicted = 0
+        victims: list = []
         stored = dedup = False
         with self._lock:
             old = self._entries.get(key)
@@ -390,6 +402,7 @@ class DeviceBatchCache:
                     self._bytes -= victim.nbytes
                     self._run_unpinned_locked(victim.run,
                                               -victim.nbytes)
+                    victims.append(victim)
                     evicted += 1
                 if self._bytes + entry.nbytes <= self._budget:
                     entry.resident = True
@@ -408,8 +421,18 @@ class DeviceBatchCache:
             if evicted:
                 _m.counter("data.hbm.evictions").inc(evicted)
             _m.gauge("data.hbm.bytes_resident").set(resident)
+            # attribution pairing: the ledger mirrors the resident
+            # gauge EXACTLY — each victim's bytes credit its owner
+            # (create=False: a folded/evicted scope's credit lands in
+            # unattributed, where its debits went), the stored entry's
+            # bytes charge its owner
+            for v in victims:
+                _attr.charge("hbm_bytes", -v.nbytes, key=v.owner,
+                             create=False)
             if stored and not dedup:
                 _m.counter("data.hbm.puts").inc()
+                _attr.charge("hbm_bytes", entry.nbytes,
+                             key=entry.owner)
         # tpudl: ignore[swallowed-except] — the observer must never
         # strand a pinned entry: accounting consistency beats a lost
         # metric tick
@@ -446,6 +469,7 @@ class DeviceBatchCache:
         that just failed, hand the allocator back everything the cache
         holds speculatively. Pinned entries — buffers an in-flight
         dispatch still reads — stay, so the budget stays honest."""
+        from tpudl.obs import attribution as _attr
         from tpudl.obs import metrics as _m
 
         freed = count = 0
@@ -464,12 +488,19 @@ class DeviceBatchCache:
         if count:
             _m.counter("data.hbm.evictions").inc(count)
         _m.gauge("data.hbm.bytes_resident").set(resident)
+        for e in victims:
+            # credit each victim's OWNING scope (put() pairing)
+            _attr.charge("hbm_bytes", -e.nbytes, key=e.owner,
+                         create=False)
         return count, freed
 
     def clear(self) -> None:
+        from tpudl.obs import attribution as _attr
         from tpudl.obs import metrics as _m
 
         with self._lock:
+            dropped = [(e.owner, e.nbytes)
+                       for e in self._entries.values()]
             for e in self._entries.values():
                 e.resident = False
             self._entries.clear()
@@ -477,6 +508,8 @@ class DeviceBatchCache:
             self._pinned_bytes = 0
             self._unpinned_by_run.clear()
         _m.gauge("data.hbm.bytes_resident").set(0)
+        for owner, nbytes in dropped:
+            _attr.charge("hbm_bytes", -nbytes, key=owner, create=False)
 
 
 _CACHE: DeviceBatchCache | None = None

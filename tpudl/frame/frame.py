@@ -146,8 +146,14 @@ class _PipelineInfeed:
             self._submit()
 
     def _submit(self):
-        self._futs.append(
-            self._ex.submit(self._prepare, *self._spans[self._next]))
+        from tpudl.obs import attribution as _attr
+
+        # the submitter's attribution scope rides onto the worker: a
+        # contextvar does not cross the pool boundary by itself, and
+        # the prepare path publishes wire/row charges that must land
+        # in the SUBMITTING run's ledger row (OBSERVABILITY.md)
+        self._futs.append(self._ex.submit(
+            _attr.carry(self._prepare), *self._spans[self._next]))
         self._next += 1
 
     def get(self, i: int):
@@ -223,7 +229,11 @@ class _DispatchWindow:
         return len(self._futs) >= self._depth
 
     def submit(self, call):
-        self._futs.append(self._ex.submit(call))
+        from tpudl.obs import attribution as _attr
+
+        # carry the consumer's attribution scope onto the dispatch
+        # thread (dispatch_s and compile_s charges happen there)
+        self._futs.append(self._ex.submit(_attr.carry(call)))
         self._report.gauge("dispatch_inflight", len(self._futs))
 
     def pop(self):
@@ -910,6 +920,7 @@ class Frame:
             raise KeyError(f"unknown input columns {missing}")
 
         from tpudl import obs  # deferred: host-only frames stay light
+        from tpudl.obs import attribution as _attr
         from tpudl.obs import flight as _flight
 
         report = obs.PipelineReport()
@@ -1130,6 +1141,10 @@ class Frame:
                 # the robustness suite raises/kills inside an exact
                 # stage at an exact batch; unarmed this is a None-check
                 _faults.fire("frame.prepare", index=bidx)
+                # attribution: rows entering the pipeline, charged in
+                # the submitting run's scope (carried onto this pool
+                # thread by _PipelineInfeed._submit)
+                _attr.charge("rows_in", stop - start)
                 if dcache is not None:
                     pin = dcache.get((dkey, bidx))
                     # an all-hits replay still needs resolved codecs
@@ -1378,6 +1393,7 @@ class Frame:
             # source (rows_done/rows_total on the status file)
             done_rows = (int(result[0].shape[0]) if result[0].ndim else 1)
             report.progress(max(0, done_rows - n_pad))
+            _attr.charge("rows_out", max(0, done_rows - n_pad))
             if mode == "acc":
                 # Keep results device-resident and fetch ONCE per column
                 # at the end: device→host fetch has a large fixed cost
@@ -1509,6 +1525,10 @@ class Frame:
                                 report=report)
                         else:
                             result = call_fn(*call_args)
+                    # attribution: device seconds this scope consumed
+                    # (the quota broker's currency, ROADMAP item 5)
+                    _attr.charge("dispatch_s",
+                                 time.perf_counter() - t_disp)
                     if not first_dispatched:
                         first_dispatched.append(True)
                         report.count("first_dispatch_s",

@@ -425,10 +425,14 @@ class Supervisor:
         ``frame.degraded``) + ``frame.degraded.rungs`` + a warning —
         recovery is silent for the caller, loud for the operator."""
         try:
+            from tpudl.obs import attribution as _attr
             from tpudl.obs import flight as _flight
             from tpudl.obs import metrics as _m
 
             _m.counter("frame.degraded.rungs").inc()
+            # attribution pairing with frame.degraded.rungs (same
+            # best-effort guard: both sides charge or neither does)
+            _attr.charge("degradations")
             _flight.record_error(
                 "frame.degraded", exc, rung=label, stage=stage,
                 rungs_applied=len(self.rungs), **extra)

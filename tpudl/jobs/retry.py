@@ -136,10 +136,14 @@ class RetryPolicy:
         """File one retry into metrics + the flight recorder (also used
         by layers that own their loop, e.g. HorovodRunner)."""
         try:
+            from tpudl.obs import attribution as _attr
             from tpudl.obs import flight as _flight
             from tpudl.obs import metrics as _metrics
 
             _metrics.counter("retry.attempts").inc()
+            # attribution pairing with retry.attempts (same
+            # best-effort guard: both sides charge or neither does)
+            _attr.charge("retries")
             _metrics.counter(f"retry.{kind}").inc()
             if backoff_s is not None:
                 _metrics.histogram("retry.backoff_s").observe(

@@ -27,6 +27,7 @@ from typing import Callable, Iterator, Sequence
 
 import jax
 
+from tpudl.obs import attribution as _attr
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
 from tpudl.obs import watchdog as _obs_watchdog
@@ -136,7 +137,11 @@ class TrialScheduler:
                     free.append(s)
 
         with ThreadPoolExecutor(max_workers=len(slices)) as pool:
-            futures = {pool.submit(run_one, i, item)
+            # the sweep caller's attribution scope rides onto every
+            # trial thread (tpudl.obs.attribution): trial publishes —
+            # wire/HBM/dispatch charges from the inner map_batches —
+            # land in the submitting tenant's ledger row
+            futures = {pool.submit(_attr.carry(run_one), i, item)
                        for i, item in enumerate(items)}
             while futures:
                 done, futures = wait(futures, return_when=FIRST_COMPLETED)

@@ -42,10 +42,23 @@ The live ops plane (OBSERVABILITY.md "Live ops plane"):
 - :mod:`tpudl.obs.live` — every instrumented process writes an atomic
   ``tpudl-status-<pid>.json`` (``TPUDL_STATUS_DIR``);
   ``python -m tpudl.obs top <dir>`` renders the refreshing live view.
+
+The attribution plane (OBSERVABILITY.md "Attribution plane"):
+
+- :mod:`tpudl.obs.attribution` — ``obs.scope(tenant=..., job=...,
+  run=...)`` tags every publish on the calling thread (carried across
+  the executor/serve/HPO pools), and the bounded per-scope resource
+  ledger answers WHO used the bytes/rows/tokens/seconds; per-scope
+  sums + ``unattributed`` reconcile EXACTLY against the global
+  counters (``python -m tpudl.obs ledger <dir>`` offline).
 """
 
 from __future__ import annotations
 
+from tpudl.obs.attribution import (Scope, carry, charge, current_scope,
+                                   get_ledger, ledger_snapshot,
+                                   ledger_totals, reconcile,
+                                   reset_ledger, scope)
 from tpudl.obs.flight import dump, get_recorder, record_error
 from tpudl.obs.live import (ensure_status_writer, start_status_writer,
                             stop_status_writer, write_status)
@@ -63,6 +76,10 @@ from tpudl.obs.trace import (load_host_trace_events, load_trace_events,
 from tpudl.obs.tracer import export_chrome_trace, get_tracer, span
 
 __all__ = [
+    # attribution plane (scoped ledgers)
+    "Scope", "scope", "current_scope", "carry", "charge",
+    "get_ledger", "reset_ledger", "ledger_snapshot", "ledger_totals",
+    "reconcile",
     # tracer
     "span", "get_tracer", "export_chrome_trace",
     # metrics

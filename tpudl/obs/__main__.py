@@ -19,6 +19,13 @@ a CPU-only run gets host totals, a host-blind capture gets device lanes.
 external kill — printing the timeline tail, per-stage throughput at
 time of death, and the suspect stage (:mod:`tpudl.obs.doctor`).
 
+``ledger <dump-or-dir>`` re-checks the attribution plane's
+reconciliation invariant offline — per-scope sums + the unattributed
+bucket against the global counters, recomputed from each artifact's own
+``ledger`` + ``metrics`` sections — over every flight dump and status
+file under the path, then prints merged per-scope totals
+(:mod:`tpudl.obs.attribution`; rc 0 reconciled / 1 mismatch / 2 none).
+
 ``top <status-dir>`` renders a refreshing terminal view of every live
 ``tpudl-status-<pid>.json`` in the directory (written by processes
 running with ``TPUDL_STATUS_DIR`` set): active runs with per-stage
@@ -136,6 +143,70 @@ def cmd_doctor(path: str, tail: int = 12) -> int:
     return 0 if diagnosis["classification"] != "unclassified" else 1
 
 
+def cmd_ledger(path: str) -> int:
+    """Offline attribution reconciliation: re-check the ledger
+    invariant (per-scope sums + unattributed == global counters) in
+    every flight dump and status file under ``path`` — recomputed from
+    the artifact's OWN ledger + metrics sections, never trusting an
+    embedded verdict — and print the merged per-scope totals.
+
+    rc contract (sibling of doctor's): 0 = every artifact reconciles,
+    1 = at least one mismatch, 2 = no ledger-bearing artifact found."""
+    from tpudl.obs import attribution as A
+    from tpudl.obs import doctor as D
+    from tpudl.obs import live as L
+
+    artifacts = []  # (label, ledger snapshot, metrics snapshot)
+    for d in D.load_dumps(path):
+        led = d.get("ledger")
+        if isinstance(led, dict):
+            artifacts.append((f"dump pid {d.get('pid')} "
+                              f"({d.get('_path', '?')})",
+                              led, d.get("metrics") or {}))
+    if os.path.isdir(path):
+        for st in L.read_statuses(path):
+            led = st.get("ledger")
+            if isinstance(led, dict):
+                artifacts.append((f"status pid {st.get('pid')} "
+                                  f"({st.get('_path', '?')})",
+                                  led, st.get("metrics") or {}))
+    if not artifacts:
+        print(f"no ledger-bearing dumps or status files under {path}",
+              file=sys.stderr)
+        return 2
+    bad = 0
+    merged: dict[str, dict] = {}
+    for label, led, metrics in artifacts:
+        rec = A.reconcile_snapshot(led, metrics)
+        verdict = "RECONCILED" if rec["ok"] else "MISMATCH"
+        print(f"{verdict}: {label} — "
+              f"{len(led.get('scopes') or {})} scope(s), "
+              f"{int(led.get('evicted') or 0)} evicted")
+        for c in rec["checks"]:
+            if not c["ok"]:
+                bad += 1
+                print(f"  {c['field']}: ledger {c['ledger']} != "
+                      f"{c['metric']} {c['global']}")
+        rows = list((led.get("scopes") or {}).items())
+        una = led.get("unattributed") or {}
+        if any(isinstance(v, (int, float)) and v for v in una.values()):
+            rows.append(("(unattributed)", una))
+        for key, row in rows:
+            at = merged.setdefault(key, {})
+            for f in A.LEDGER_FIELDS:
+                v = row.get(f)
+                if isinstance(v, (int, float)):
+                    at[f] = at.get(f, 0.0) + float(v)
+    print(f"\n== merged scope totals ({len(artifacts)} artifact(s)) ==")
+    for key, row in sorted(merged.items()):
+        bits = [f"{f} {row[f]:.0f}" for f in
+                ("rows_in", "rows_out", "tokens_in", "tokens_out",
+                 "wire_bytes", "hbm_bytes", "serve_completed")
+                if row.get(f)]
+        print(f"  {key:<28} " + ("  ".join(bits) or "(no charges)"))
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tpudl.obs",
@@ -152,6 +223,12 @@ def main(argv=None) -> int:
     pd.add_argument("path", help="one tpudl-dump-*.json.gz or a dir of them")
     pd.add_argument("--tail", type=int, default=12,
                     help="timeline tail length (default 12 spans)")
+    pl = sub.add_parser(
+        "ledger",
+        help="offline attribution reconciliation over dumps/status "
+             "files")
+    pl.add_argument("path",
+                    help="one dump file or a dir of dumps/status files")
     pp = sub.add_parser(
         "top", help="live view of tpudl-status-*.json files in a dir")
     pp.add_argument("status_dir",
@@ -165,6 +242,8 @@ def main(argv=None) -> int:
         return cmd_trace(args.trace_dir, args.out)
     if args.cmd == "doctor":
         return cmd_doctor(args.path, args.tail)
+    if args.cmd == "ledger":
+        return cmd_ledger(args.path)
     if args.cmd == "top":
         from tpudl.obs import live as L
 

@@ -232,6 +232,48 @@ def _stall_stage(stall: dict) -> str | None:
     return str(stage) if stage is not None else None
 
 
+def _ledger_evidence(hosts: dict) -> list[str]:
+    """Common evidence from the dumps' attribution ledgers (v3): name
+    the scope that dominated the process at death — the WHO axis every
+    classification benefits from in a multi-tenant process — and flag a
+    broken reconciliation (a ledger/global mismatch is itself a bug
+    worth surfacing, whatever killed the run)."""
+    merged: dict[str, float] = {}
+    hbm: dict[str, float] = {}
+    bad_checks: list[str] = []
+    for d in hosts.values():
+        led = d.get("ledger") or {}
+        rows = list((led.get("scopes") or {}).items())
+        una = led.get("unattributed") or {}
+        if any(isinstance(v, (int, float)) and v for v in una.values()):
+            rows.append(("(unattributed)", una))
+        for key, row in rows:
+            work = sum(float(row.get(f) or 0) for f in
+                       ("rows_in", "rows_out", "tokens_in",
+                        "tokens_out", "serve_completed"))
+            merged[key] = merged.get(key, 0.0) + work
+            hbm[key] = hbm.get(key, 0.0) \
+                + float(row.get("hbm_bytes") or 0)
+        rec = led.get("reconcile") or {}
+        if rec and not rec.get("ok", True):
+            bad_checks.extend(
+                f"{c['field']} ledger {c['ledger']} != global "
+                f"{c['global']}" for c in rec.get("checks", [])
+                if not c.get("ok"))
+    out: list[str] = []
+    if merged:
+        key, work = max(merged.items(), key=lambda kv: kv[1])
+        line = (f"dominant scope at death: {key} "
+                f"({work:.0f} rows+tokens attributed")
+        if hbm.get(key):
+            line += f", {hbm[key] / 2**20:.1f} MB HBM resident"
+        out.append(line + f"; {len(merged)} scope(s) in the ledger)")
+    if bad_checks:
+        out.append("ledger reconciliation BROKEN at death: "
+                   + "; ".join(bad_checks[:3]))
+    return out
+
+
 def _is_infeed(stall: dict) -> bool:
     stage = (_stall_stage(stall) or "").lower()
     if any(k in stage for k in INFEED_STAGES):
@@ -281,6 +323,7 @@ def classify(merged: dict) -> dict:
             f"{str(restarts[-1].get('error'))[:120]} "
             f"(attempt {restarts[-1].get('attempt')}, "
             f"step {restarts[-1].get('step')})")
+    evidence.extend(_ledger_evidence(hosts))
 
     # 1. the job runtime turned the kill into a recovery event: the
     #    dump says so (reason) or carries the job.preempted breadcrumb

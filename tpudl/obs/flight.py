@@ -60,7 +60,10 @@ __all__ = ["FlightRecorder", "get_recorder", "record_error",
            "DUMP_SCHEMA", "DUMP_VERSION", "dump_path_for"]
 
 DUMP_SCHEMA = "tpudl-flight-dump"
-DUMP_VERSION = 2
+# v3: + "ledger" (attribution snapshot + reconciliation verdict) so the
+# doctor can name the dominant scope at death and the offline
+# `python -m tpudl.obs ledger` reconciliation has its right-hand side
+DUMP_VERSION = 3
 
 _DUMP_SEQ = itertools.count()  # tmp-name uniqueness across dump writers
 
@@ -360,6 +363,20 @@ class FlightRecorder:
         # takes what it can get; the empty default marks the gap
         except Exception:
             payload["heartbeats"] = {}
+        try:
+            from tpudl.obs import attribution as _attr
+
+            led = _attr.ledger_snapshot()
+            # the verdict is computed against THIS dump's metrics copy,
+            # so the pair in the artifact is self-consistent even if
+            # counters kept moving after the snapshot above
+            led["reconcile"] = _attr.reconcile(payload.get("metrics")
+                                               or None)
+            payload["ledger"] = led
+        # tpudl: ignore[swallowed-except] — dying-interpreter dump
+        # takes what it can get; the None default marks the gap
+        except Exception:
+            payload["ledger"] = None
         return payload
 
     def dump(self, reason: str = "manual", error=None,

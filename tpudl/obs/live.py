@@ -54,7 +54,8 @@ STATUS_PREFIX = "tpudl-status-"
 _METRIC_PREFIXES = ("train.", "hpo.", "udf.", "estimator.",
                     "obs.watchdog.", "obs.roofline.",
                     "frame.map_batches.", "frame.degraded.", "retry.",
-                    "data.hbm.", "compile.", "serve.")
+                    "data.hbm.", "data.wire.", "compile.", "serve.",
+                    "attribution.")
 
 
 def _status_dir() -> str | None:
@@ -162,9 +163,11 @@ def collect_status(roofline: bool = True) -> dict:
     try:
         from tpudl.obs import metrics as _metrics
 
-        payload["metrics"] = {
-            name: m for name, m in _metrics.snapshot().items()
-            if name.startswith(_METRIC_PREFIXES)}
+        # filtered AT the registry (ISSUE 20): the 1 Hz writer copies
+        # only the sections it ships instead of snapshotting the whole
+        # table and discarding most of it — the <5% overhead guard's
+        # margin lives here
+        payload["metrics"] = _metrics.snapshot(prefix=_METRIC_PREFIXES)
         hbm = _hbm_section(payload["metrics"], payload["ts"])
         if hbm is not None:
             payload["hbm"] = hbm
@@ -174,6 +177,16 @@ def collect_status(roofline: bool = True) -> dict:
         srv = _serve_section(payload["metrics"])
         if srv is not None:
             payload["serve"] = srv
+    # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
+    # contributor drops its section, never the whole status file
+    except Exception:
+        pass
+    try:
+        from tpudl.obs import attribution as _attr
+
+        led = _attr.status_section()
+        if led is not None:
+            payload["ledger"] = led
     # tpudl: ignore[swallowed-except] — 1 Hz status thread: a broken
     # contributor drops its section, never the whole status file
     except Exception:
@@ -502,6 +515,79 @@ def _fleet_serve_line(serves: list[dict]) -> str:
     return line
 
 
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    if abs(n) >= 2**30:
+        return f"{n / 2**30:.2f}GB"
+    if abs(n) >= 2**20:
+        return f"{n / 2**20:.1f}MB"
+    if abs(n) >= 2**10:
+        return f"{n / 2**10:.1f}KB"
+    return f"{n:.0f}B"
+
+
+def _ledger_rows(led: dict) -> list[tuple]:
+    """(key, row) pairs worth rendering: every named scope plus the
+    unattributed bucket when it actually carries charges."""
+    rows = list((led.get("scopes") or {}).items())
+    una = led.get("unattributed") or {}
+    if any(una.get(f) for f in ("rows_in", "rows_out", "tokens_in",
+                                "tokens_out", "wire_bytes", "hbm_bytes",
+                                "serve_completed")):
+        rows.append(("(unattributed)", una))
+    return rows
+
+
+def _ledger_line(key: str, row: dict) -> str:
+    """One ``obs top`` attribution row: who, rows/s, tokens/s, HBM
+    share, wire bytes — the ISSUE 20 per-tenant surface."""
+    line = f"    {key:<24}"
+    rs, ts = row.get("rows_s"), row.get("tokens_s")
+    line += (f" {rs:.1f} rows/s" if isinstance(rs, (int, float))
+             else " - rows/s")
+    line += (f"  {ts:.1f} tok/s" if isinstance(ts, (int, float))
+             else "  - tok/s")
+    share = row.get("hbm_share")
+    if row.get("hbm_bytes") or share:
+        line += (f"  hbm {_fmt_bytes(row.get('hbm_bytes') or 0)}"
+                 + (f" ({100 * share:.0f}%)"
+                    if isinstance(share, (int, float)) and share > 0
+                    else ""))
+    if row.get("wire_bytes"):
+        line += f"  wire {_fmt_bytes(row['wire_bytes'])}"
+    if row.get("serve_completed"):
+        line += f"  served {row['serve_completed']:.0f}"
+    return line
+
+
+def _fleet_ledger_lines(ledgers: list[dict]) -> list[str]:
+    """Per-tenant rows merged across every process's ledger section
+    (the ``_fleet_serve_line`` treatment for attribution): additive
+    fields and per-proc rates SUM; the HBM share is recomputed over
+    the merged resident total."""
+    merged: dict[str, dict] = {}
+    evicted = 0
+    for led in ledgers:
+        evicted += int(led.get("evicted") or 0)
+        for key, row in _ledger_rows(led):
+            at = merged.setdefault(key, {})
+            for f, v in row.items():
+                if not isinstance(v, (int, float)):
+                    continue
+                if f == "hbm_share":
+                    continue  # recomputed below, shares don't add
+                at[f] = at.get(f, 0.0) + v
+    resident = sum(r.get("hbm_bytes") or 0 for r in merged.values())
+    lines = [f"fleet tenants ({len(ledgers)} procs, "
+             f"{len(merged)} scopes"
+             + (f", {evicted} evicted" if evicted else "") + "):"]
+    for key, row in sorted(merged.items()):
+        row["hbm_share"] = ((row.get("hbm_bytes") or 0) / resident
+                            if resident > 0 else 0.0)
+        lines.append(_ledger_line(key, row))
+    return lines
+
+
 def render(statuses: list[dict], now: float | None = None) -> str:
     """One text frame over parsed status payloads — pure (testable)."""
     now = now if now is not None else time.time()
@@ -512,6 +598,9 @@ def render(statuses: list[dict], now: float | None = None) -> str:
     serves = [st.get("serve") for st in statuses if st.get("serve")]
     if len(serves) >= 2:
         lines.append(_fleet_serve_line(serves))
+    ledgers = [st.get("ledger") for st in statuses if st.get("ledger")]
+    if len(ledgers) >= 2:
+        lines.extend(_fleet_ledger_lines(ledgers))
     for st in statuses:
         age = now - (st.get("ts") or now)
         stale_after = 3 * float(st.get("interval_s") or 1.0) + 2.0
@@ -630,6 +719,15 @@ def render(statuses: list[dict], now: float | None = None) -> str:
             if srv.get("models", 0) > 1:
                 line += f"  models {srv['models']}"
             lines.append(line)
+        led = st.get("ledger") or {}
+        led_rows = _ledger_rows(led)
+        if led_rows:
+            head = f"  tenants:    {len(led.get('scopes') or {})} scope(s)"
+            if led.get("evicted"):
+                head += f"  evicted {led['evicted']}"
+            lines.append(head)
+            for key, row in led_rows:
+                lines.append(_ledger_line(key, row))
         rl = st.get("roofline") or {}
         if rl.get("verdict"):
             lines.append(f"  roofline:   {rl['verdict']}")

@@ -204,9 +204,15 @@ class MetricsRegistry:
         is at stake)."""
         return self._get(name, Histogram, cap=cap)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, prefix=None) -> dict:
+        """Plain-dict view of the registry. ``prefix`` (a string or a
+        tuple of strings, as for ``str.startswith``) filters INSIDE the
+        lock so per-tick readers — the 1 Hz status writer, the SLO
+        engine — copy and serialize only the names they render instead
+        of the whole registry."""
         with self._lock:
-            items = list(self._metrics.items())
+            items = [(name, m) for name, m in self._metrics.items()
+                     if prefix is None or name.startswith(prefix)]
         return {name: m.to_dict() for name, m in sorted(items)}
 
     def reset(self):
@@ -280,8 +286,8 @@ def histogram(name: str, cap: int = DEFAULT_SAMPLE_CAP) -> Histogram:
     return _REGISTRY.histogram(name, cap=cap)
 
 
-def snapshot() -> dict:
-    return _REGISTRY.snapshot()
+def snapshot(prefix=None) -> dict:
+    return _REGISTRY.snapshot(prefix=prefix)
 
 
 def flush_metrics(event: str = "snapshot") -> bool:

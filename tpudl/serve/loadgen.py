@@ -25,11 +25,13 @@ server dies mid-run.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
 import numpy as np
 
+from tpudl.obs import attribution as _attr
 from tpudl.obs.metrics import percentile
 from tpudl.serve.queue import AdmissionError
 from tpudl.testing import faults as _faults
@@ -49,13 +51,20 @@ def run_closed_loop(server, make_prompt, *, requests: int,
                     clients: int = 4, max_new: int = 8,
                     model: str = "default",
                     deadline_s: float | None = None,
-                    timeout: float = 120.0) -> dict:
+                    timeout: float = 120.0,
+                    tenant=None) -> dict:
     """Drive ``requests`` total requests through ``server`` with
     ``clients`` closed-loop threads; returns the SLO summary.
 
     ``make_prompt(i)`` supplies the i-th prompt (ragged lengths are
     the point — the serve loop buckets them). The server must already
-    be started (or be run concurrently by the caller)."""
+    be started (or be run concurrently by the caller).
+
+    ``tenant`` stamps the generated requests with an attribution scope
+    (tpudl.obs.attribution): a string tags every client with that
+    tenant; a sequence assigns client ``c`` the ``c % len``-th entry —
+    the two-tenant serve sub-bench drives attribution end to end with
+    ``tenant=("a", "b")``. None leaves requests unattributed."""
     # one leaf lock for every tally: the critical sections are scalar
     # bumps/list appends and never nest with the server's locks
     lock = _tsan.named_lock("serve.loadgen")
@@ -98,20 +107,33 @@ def run_closed_loop(server, make_prompt, *, requests: int,
             if req.ttft_s is not None:
                 ttfts.append(req.ttft_s)
 
+    def _tenant_of(cid: int):
+        if tenant is None or isinstance(tenant, str):
+            return tenant
+        seq = list(tenant)
+        return seq[cid % len(seq)] if seq else None
+
     def _client(cid: int):
-        while True:
-            i = _next_index()
-            if i >= int(requests):
-                return
-            burst = _faults.fire("serve.tick", tick=i, client=cid)
-            if burst:
-                # the injected spike: count extra submits in ONE tick,
-                # fire-and-forget — their fate (served or typed-
-                # rejected) is exactly what the chaos case asserts on
-                for j in range(int(burst)):
-                    _submit(i, wait=False)
-            _faults.fire("serve.client", client=cid, i=i)
-            _submit(i, wait=True)
+        # the client thread IS the submit thread, so entering the
+        # scope here is exactly where ServeRequest captures it
+        ctx = (_attr.scope(tenant=_tenant_of(cid))
+               if _tenant_of(cid) is not None
+               else contextlib.nullcontext())
+        with ctx:
+            while True:
+                i = _next_index()
+                if i >= int(requests):
+                    return
+                burst = _faults.fire("serve.tick", tick=i, client=cid)
+                if burst:
+                    # the injected spike: count extra submits in ONE
+                    # tick, fire-and-forget — their fate (served or
+                    # typed-rejected) is exactly what the chaos case
+                    # asserts on
+                    for j in range(int(burst)):
+                        _submit(i, wait=False)
+                _faults.fire("serve.client", client=cid, i=i)
+                _submit(i, wait=True)
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=_client, args=(c,),

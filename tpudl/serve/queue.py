@@ -29,6 +29,7 @@ from collections import deque
 
 import numpy as np
 
+from tpudl.obs import attribution as _attr
 from tpudl.obs import metrics as _metrics
 from tpudl.serve import reqtrace as _reqtrace
 from tpudl.testing import tsan as _tsan
@@ -85,7 +86,7 @@ class ServeRequest:
 
     __slots__ = ("prompt", "max_new", "model", "rng", "submitted",
                  "deadline", "tokens", "error", "ttft_s", "latency_s",
-                 "done", "trace")
+                 "done", "trace", "scope")
 
     def __init__(self, prompt, max_new: int, *, model: str = "default",
                  deadline_s: float | None = None, rng=None):
@@ -115,6 +116,11 @@ class ServeRequest:
         self.trace = _reqtrace.new_trace()
         if self.trace is not None:
             self.trace.stamp("submit")
+        # attribution scope captured on the CLIENT thread: the loop
+        # thread serves many tenants per tick, so per-request charges
+        # (completions, tokens, SLO samples) follow the submitter's
+        # scope, not the loop's (tpudl.obs.attribution)
+        self.scope = _attr.current_scope()
 
     @property
     def nbytes(self) -> int:
@@ -219,6 +225,11 @@ class RequestQueue:
             req.trace.stamp("admit")
         _metrics.counter("serve.requests").inc()
         _metrics.gauge("serve.queue_depth").set(depth)
+        # attribution: prompt tokens entering the serve plane, charged
+        # to the submitter's captured scope (rejects charge nothing)
+        _attr.charge("tokens_in", int(req.prompt.shape[1]),
+                     key=req.scope.key if req.scope is not None
+                     else None)
         return req
 
     def take(self, k: int, *, model: str | None = None) -> list:

@@ -30,6 +30,7 @@ from __future__ import annotations
 import threading
 import time
 
+from tpudl.obs import attribution as _attr
 from tpudl.obs import flight as _flight
 from tpudl.obs import metrics as _metrics
 from tpudl.obs import pipeline as _pipeline
@@ -246,6 +247,17 @@ class Server:
                 _metrics.histogram("serve.latency_ms").observe(
                     req.latency_s * 1000.0)
                 _metrics.counter("serve.completed").inc()
+                # attribution: the loop thread serves every tenant, so
+                # per-request charges follow the scope captured at
+                # submit — paired 1:1 with serve.completed and the
+                # latency observe above (the reconciliation contract)
+                skey = (req.scope.key if req.scope is not None
+                        else None)
+                _attr.charge("serve_completed", key=skey)
+                _attr.charge("slo_samples", key=skey)
+                _attr.charge("tokens_out",
+                             int(getattr(req.tokens, "size", 0)),
+                             key=skey)
                 # windowed SLO stamp + tail-exemplar check, then the
                 # flight recorder's request ring (descriptor only)
                 slo.record(req)

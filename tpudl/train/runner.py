@@ -33,6 +33,7 @@ import numpy as np
 from tpudl import distributed as D
 from tpudl import mesh as M
 from tpudl.jobs.retry import RetryPolicy, is_fatal
+from tpudl.obs import attribution as _attr
 from tpudl.obs import flight as _obs_flight
 from tpudl.obs import metrics as _obs_metrics
 from tpudl.obs import tracer as _obs_tracer
@@ -486,6 +487,10 @@ class Trainer:
                 step_gauge.set(step + 1)
                 executed += 1
                 examples += int(np.shape(batch[0])[0])
+                # attribution: training rows consumed under the
+                # caller's scope — fit publishes on the calling thread,
+                # so the contextvar needs no explicit carry here
+                _attr.charge("rows_in", int(np.shape(batch[0])[0]))
                 done = step + 1
                 if mgr is not None and done < steps:
                     t_ck = time.perf_counter()
